@@ -1,0 +1,22 @@
+"""emqx_tpu — a TPU-native distributed MQTT messaging framework.
+
+Brand-new framework with the capabilities of the reference EMQ X broker
+(/root/reference): MQTT 3.1/3.1.1/5.0 pub/sub with +/# wildcard routing,
+shared subscriptions, QoS 0/1/2 sessions, retained/delayed messages, hooks,
+rule engine, authn/authz, clustering, management — with the wildcard
+topic-match + fan-out hot path executed as a batched NFA over a columnar
+HBM-resident trie on TPU (JAX/XLA/Pallas), instead of the reference's
+per-message ETS/mnesia trie walks (emqx_trie.erl:208-266).
+
+Package layout:
+  utils/     topic algebra, ids, metrics, small pure helpers
+  mqtt/      MQTT v3.1.1/v5 wire codec and packet model (emqx_frame.erl)
+  ops/       device-side ops: interning, columnar trie, batched match,
+             fan-out gather, shared-sub selection (emqx_trie/emqx_broker)
+  parallel/  mesh + shard_map sharded matching, multi-host plumbing
+  models/    the flagship jittable "route engine" step combining the ops
+  broker/    host runtime: listeners, connections, channel FSM, sessions,
+             connection manager, hooks, pubsub engine (emqx_broker.erl)
+"""
+
+from emqx_tpu.version import __version__  # noqa: F401

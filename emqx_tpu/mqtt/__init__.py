@@ -1,0 +1,10 @@
+"""MQTT wire protocol: packet model, v3.1/v3.1.1/v5 codec, properties.
+
+Parity targets in the reference: emqx_frame.erl (streaming parse/serialize),
+emqx_packet.erl (packet helpers), emqx_mqtt_props.erl (v5 property tables),
+emqx_reason_codes.erl (reason codes).
+"""
+
+from emqx_tpu.mqtt.constants import *  # noqa: F401,F403
+from emqx_tpu.mqtt.packet import *  # noqa: F401,F403
+from emqx_tpu.mqtt.frame import FrameParser, serialize, FrameError  # noqa: F401

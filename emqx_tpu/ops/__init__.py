@@ -1,0 +1,9 @@
+"""Device-side ops: interning, columnar trie, batched NFA match, fan-out.
+
+This package is the TPU-native replacement for the reference's per-message
+trie walk (emqx_trie.erl:208-266) and subscriber fold (emqx_broker.erl:282-308):
+topic levels are dictionary-encoded to int32 ids, the wildcard-filter trie is
+compiled to flat device arrays (hash-table edges + per-node '+'/'#' slots),
+and PUBLISH matching runs as a level-stepped batched NFA under jit/vmap,
+sharded over filter space with shard_map.
+"""

@@ -1,0 +1,61 @@
+"""Topic-level interning: level strings → dense int32 ids.
+
+TPUs cannot branch on strings; every topic level is dictionary-encoded
+host-side before it reaches the device (SURVEY.md §7 "Strings on TPU").
+Reserved ids:
+
+  PAD (0)      padding beyond a topic's level count
+  PLUS (1)     the '+' wildcard word
+  HASH (2)     the '#' wildcard word
+  UNKNOWN (3)  a publish-topic word that appears in no filter — it can never
+               take an exact trie edge, but still matches '+'/'#'
+
+Dynamic ids start at FIRST_DYNAMIC and are assigned on first sight of a word
+in a *filter* (publish topics use lookup(), which never allocates).
+"""
+
+from __future__ import annotations
+
+PAD = 0
+PLUS = 1
+HASH = 2
+UNKNOWN = 3
+FIRST_DYNAMIC = 4
+
+
+class InternTable:
+    """Host-side word ↔ id map. Not thread-safe; owned by the router's
+    single-writer update task (the reference serializes route mutations the
+    same way via pooled workers, emqx_broker.erl:427-428)."""
+
+    def __init__(self):
+        self._to_id: dict[str, int] = {"+": PLUS, "#": HASH}
+        self._to_word: list = [None, "+", "#", None]  # PAD/UNKNOWN unmapped
+
+    def __len__(self) -> int:
+        return len(self._to_word)
+
+    def intern(self, word: str) -> int:
+        """Get-or-assign an id for a filter word."""
+        wid = self._to_id.get(word)
+        if wid is None:
+            wid = len(self._to_word)
+            self._to_id[word] = wid
+            self._to_word.append(word)
+        return wid
+
+    def lookup(self, word: str) -> int:
+        """Id for a publish-topic word; UNKNOWN if never seen in a filter."""
+        return self._to_id.get(word, UNKNOWN)
+
+    def word(self, wid: int) -> str:
+        w = self._to_word[wid]
+        if w is None:
+            raise KeyError(f"id {wid} has no word")
+        return w
+
+    def encode_filter(self, words: list[str]) -> list[int]:
+        return [self.intern(w) for w in words]
+
+    def encode_topic(self, words: list[str]) -> list[int]:
+        return [self.lookup(w) for w in words]

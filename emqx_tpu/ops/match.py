@@ -1,0 +1,146 @@
+"""Batched wildcard topic matching on TPU: a level-stepped NFA over TrieTables.
+
+Replaces the reference's per-message recursive trie walk
+(emqx_trie.erl:208-266) with one jitted program that matches a whole batch of
+publish topics at once:
+
+  - the *batch* is the parallel axis (vectorized over topics),
+  - topic *levels* are the time axis, advanced with `lax.scan`,
+  - each topic carries a fixed-capacity NFA *frontier* of live trie nodes;
+    per level every frontier node expands into its exact-word child (hash
+    table probe) and its '+' child, and emits its '#' child's filter,
+  - matches are compacted into a fixed [B, match_cap] output with per-topic
+    counts; capacity overflow is reported per topic so the host can fall back
+    to `HostTrie` for those rare topics (static shapes stay static).
+
+Semantics match emqx_topic.erl match/2 incl. the root-level '$' exclusion
+(topics whose first level starts with '$' skip root '+'/'#' branches) and
+"sport/# matches sport" ('#' matches zero levels).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.ops.intern import PAD
+from emqx_tpu.ops.trie import MAX_PROBES, TrieTables, mix_hash
+
+
+class MatchResult(NamedTuple):
+    matches: jax.Array   # [B, match_cap] int32 filter ids, -1 padded
+    counts: jax.Array    # [B] int32 true match count (may exceed match_cap)
+    overflow: jax.Array  # [B] bool — frontier or match capacity exceeded
+
+
+def edge_lookup(tables: TrieTables, parent: jax.Array, word: jax.Array) -> jax.Array:
+    """Hash-table edge probe: child node id or -1. Shapes broadcast."""
+    S = tables.slot_parent.shape[0]
+    mask = jnp.uint32(S - 1)
+    h = mix_hash(parent, word) & mask
+    child = jnp.full(jnp.broadcast_shapes(parent.shape, word.shape), -1, jnp.int32)
+    for p in range(MAX_PROBES):
+        idx = ((h + np.uint32(p)) & mask).astype(jnp.int32)
+        hit = ((parent >= 0) & (tables.slot_parent[idx] == parent)
+               & (tables.slot_word[idx] == word))
+        child = jnp.where(hit & (child < 0), tables.slot_child[idx], child)
+    return child
+
+
+def _gather_node(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """arr[idx] with -1 indices yielding -1."""
+    safe = jnp.clip(idx, 0, arr.shape[0] - 1)
+    return jnp.where(idx >= 0, arr[safe], -1)
+
+
+@functools.partial(jax.jit, static_argnames=("frontier_cap", "match_cap"))
+def match_batch(tables: TrieTables, topics: jax.Array, lens: jax.Array,
+                is_dollar: jax.Array, *, frontier_cap: int = 16,
+                match_cap: int = 64) -> MatchResult:
+    """Match a batch of publish topics against the compiled trie.
+
+    topics: [B, L] int32 interned level ids (PAD beyond lens[b]).
+    lens: [B] int32 level counts. is_dollar: [B] bool ('$'-rooted topics).
+    """
+    B, L = topics.shape
+    F, M = frontier_cap, match_cap
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+    # rows with lens == 0 are batch padding: start with an empty frontier
+    root0 = jnp.where(lens > 0, 0, -1).astype(jnp.int32)
+    frontier0 = jnp.full((B, F), -1, jnp.int32).at[:, 0].set(root0)
+    out0 = jnp.full((B, M), -1, jnp.int32)
+    count0 = jnp.zeros(B, jnp.int32)
+    oflow0 = jnp.zeros(B, bool)
+
+    # scan steps l = 0..L inclusive; word input only consumed while l < len
+    words_t = jnp.concatenate(
+        [topics.T, jnp.full((1, B), PAD, topics.dtype)], axis=0)
+    steps = jnp.arange(L + 1, dtype=jnp.int32)
+
+    def step(carry, xs):
+        frontier, out, count, oflow = carry
+        l, w = xs
+        active = frontier >= 0
+
+        # --- emissions at depth l ---
+        hc = _gather_node(tables.hash_child, frontier)
+        skip_root_wild = (is_dollar & (l == 0))[:, None]
+        hash_fid = _gather_node(tables.node_filter, hc)
+        hash_emit = active & (hash_fid >= 0) & ~skip_root_wild
+        exact_fid = _gather_node(tables.node_filter, frontier)
+        exact_emit = active & (exact_fid >= 0) & (l == lens)[:, None]
+        emit_fid = jnp.concatenate([hash_fid, exact_fid], axis=1)
+        emit_mask = jnp.concatenate([hash_emit, exact_emit], axis=1)
+
+        pos = count[:, None] + jnp.cumsum(emit_mask, axis=1) - 1
+        pos = jnp.where(emit_mask, pos, M)  # out-of-range → dropped
+        out = out.at[rows, pos].set(emit_fid, mode="drop")
+        count = count + emit_mask.sum(axis=1, dtype=jnp.int32)
+
+        # --- frontier expansion with word w ---
+        expanding = active & (l < lens)[:, None]
+        parent = jnp.where(expanding, frontier, -1)
+        c_exact = edge_lookup(tables, parent, w[:, None])
+        c_plus = jnp.where(expanding & ~skip_root_wild,
+                           _gather_node(tables.plus_child, frontier), -1)
+        cand = jnp.concatenate([c_exact, c_plus], axis=1)  # [B, 2F]
+        order = jnp.argsort(cand < 0, axis=1, stable=True)  # valid lanes first
+        cand = jnp.take_along_axis(cand, order, axis=1)
+        frontier = cand[:, :F]
+        oflow = oflow | (cand[:, F:] >= 0).any(axis=1)
+
+        return (frontier, out, count, oflow), None
+
+    (frontier, out, count, oflow), _ = jax.lax.scan(
+        step, (frontier0, out0, count0, oflow0), (steps, words_t))
+
+    oflow = oflow | (count > M)
+    return MatchResult(matches=out, counts=jnp.minimum(count, M), overflow=oflow)
+
+
+def encode_topics(intern, topic_words: list, max_levels: int):
+    """Host helper: list of word-lists → (topics [B,L], lens [B], is_dollar [B]).
+
+    Topics longer than max_levels are truncated and flagged via the returned
+    `too_long` mask — the caller must route those to the host fallback.
+    """
+    B = len(topic_words)
+    L = max_levels
+    topics = np.full((B, L), PAD, np.int32)
+    lens = np.zeros(B, np.int32)
+    dollar = np.zeros(B, bool)
+    too_long = np.zeros(B, bool)
+    for i, ws in enumerate(topic_words):
+        n = len(ws)
+        if n > L:
+            too_long[i] = True
+            n = L
+        lens[i] = n
+        dollar[i] = ws[0].startswith("$") if ws else False
+        topics[i, :n] = [intern.lookup(w) for w in ws[:n]]
+    return topics, lens, dollar, too_long

@@ -1,0 +1,174 @@
+"""Topic algebra: tokenize, validate, wildcard-match, $share/$queue parsing.
+
+Pure functions, no JAX. This module is the *conformance oracle* for the
+device-side batched matcher (`emqx_tpu.ops.match`): randomized property tests
+assert trie-match == brute-force `match()` over the same filter set.
+
+Behavioral parity with the reference broker's topic algebra
+(`/root/reference/apps/emqx/src/emqx_topic.erl`):
+
+- levels split on ``/``; empty levels are real levels (``"/a"`` has 2 levels).
+- ``+`` matches exactly one level (including an empty one); ``#`` matches the
+  remaining levels *including zero* (``sport/#`` matches ``sport``).
+- A topic NAME whose first byte is ``$`` never matches a filter whose first
+  byte is ``+`` or ``#`` (root-level wildcard exclusion only; deeper levels
+  starting with ``$`` are ordinary) — emqx_topic.erl:66-69.
+- Filters: ``#`` only as the last level, ``+`` only alone in a level, no
+  wildcard/NUL bytes inside a word; names additionally reject all wildcards —
+  emqx_topic.erl:89-127.
+- ``$share/<group>/<filter>`` and ``$queue/<filter>`` shared-subscription
+  prefixes — emqx_topic.erl:197-220.
+- Max topic length 65535 bytes — emqx_topic.erl:45.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+MAX_TOPIC_LEN = 65535
+
+PLUS = "+"
+HASH = "#"
+SHARE_PREFIX = "$share/"
+QUEUE_PREFIX = "$queue/"
+
+
+class TopicError(ValueError):
+    """Invalid topic name or filter. `.code` mirrors the reference's error atoms."""
+
+    def __init__(self, code: str, topic: str = ""):
+        super().__init__(f"{code}: {topic!r}" if topic else code)
+        self.code = code
+        self.topic = topic
+
+
+def tokens(topic: str) -> list[str]:
+    """Split a topic into levels on '/'. '' yields ['']."""
+    return topic.split("/")
+
+
+# `words` is an alias: unlike the Erlang reference we keep '+'/'#'/'' as plain
+# strings rather than atoms; all consumers compare strings.
+words = tokens
+
+
+def levels(topic: str) -> int:
+    return len(tokens(topic))
+
+
+def wildcard(topic: "str | Iterable[str]") -> bool:
+    """Does the topic filter contain '+' or '#' as a whole level?"""
+    ws = tokens(topic) if isinstance(topic, str) else topic
+    return any(w == PLUS or w == HASH for w in ws)
+
+
+def match(name: "str | list[str]", filt: "str | list[str]") -> bool:
+    """Match a topic *name* against a topic *filter*.
+
+    Accepts strings or pre-split word lists. The `$`-exclusion rule applies
+    only when both are strings (root-level check on the raw first byte),
+    mirroring the reference's binary-head clauses.
+    """
+    if isinstance(name, str) and isinstance(filt, str):
+        if name[:1] == "$" and filt[:1] in (PLUS, HASH):
+            return False
+        return match_words(tokens(name), tokens(filt))
+    n = tokens(name) if isinstance(name, str) else name
+    f = tokens(filt) if isinstance(filt, str) else filt
+    return match_words(n, f)
+
+
+def match_words(n: list[str], f: list[str]) -> bool:
+    """Word-level match; no `$` special-casing (caller's concern)."""
+    i, j, ln, lf = 0, 0, len(n), len(f)
+    while True:
+        if j >= lf:
+            return i >= ln
+        fw = f[j]
+        if fw == HASH:
+            # '#' must be last in a valid filter; matches any tail incl. empty
+            return True
+        if i >= ln:
+            return False
+        if fw == PLUS or n[i] == fw:
+            i += 1
+            j += 1
+        else:
+            return False
+
+
+def validate(topic: str, kind: str = "filter") -> bool:
+    """Validate a topic filter or name; raises TopicError, returns True.
+
+    kind: 'filter' (wildcards allowed) or 'name' (no wildcards).
+    """
+    if kind not in ("filter", "name"):
+        raise ValueError(f"kind must be 'filter' or 'name', got {kind!r}")
+    if topic == "":
+        raise TopicError("empty_topic")
+    if len(topic.encode("utf-8")) > MAX_TOPIC_LEN:
+        raise TopicError("topic_too_long", topic)
+    ws = tokens(topic)
+    _validate_words(ws, topic)
+    if kind == "name" and wildcard(ws):
+        raise TopicError("topic_name_error", topic)
+    return True
+
+
+def _validate_words(ws: list[str], topic: str) -> None:
+    last = len(ws) - 1
+    for i, w in enumerate(ws):
+        if w == HASH:
+            if i != last:
+                raise TopicError("topic_invalid_#", topic)
+        elif w == PLUS or w == "":
+            continue
+        else:
+            if any(c in ("#", "+", "\x00") for c in w):
+                raise TopicError("topic_invalid_char", topic)
+
+
+def parse(topic_filter: str, options: Optional[dict] = None) -> tuple[str, dict]:
+    """Strip `$share/<group>/` / `$queue/` prefixes → (real_filter, options).
+
+    options gains {'share': <group>} for shared subscriptions ('$queue' group
+    for the $queue form). Nested share prefixes are invalid.
+    """
+    options = dict(options or {})
+    if topic_filter.startswith(QUEUE_PREFIX):
+        if "share" in options:
+            raise TopicError("invalid_topic_filter", topic_filter)
+        return parse(topic_filter[len(QUEUE_PREFIX):], {**options, "share": "$queue"})
+    if topic_filter.startswith(SHARE_PREFIX):
+        if "share" in options:
+            raise TopicError("invalid_topic_filter", topic_filter)
+        rest = topic_filter[len(SHARE_PREFIX):]
+        group, sep, filt = rest.partition("/")
+        if not sep:
+            raise TopicError("invalid_topic_filter", topic_filter)
+        if "+" in group or "#" in group:
+            raise TopicError("invalid_topic_filter", topic_filter)
+        return parse(filt, {**options, "share": group})
+    return topic_filter, options
+
+
+def join(ws: Iterable[str]) -> str:
+    return "/".join(ws)
+
+
+def prepend(prefix: Optional[str], topic: str) -> str:
+    """Prepend a mountpoint prefix, ensuring exactly one '/' between parts."""
+    if not prefix:
+        return topic
+    if prefix.endswith("/"):
+        return prefix + topic
+    return prefix + "/" + topic
+
+
+def feed_var(var: str, val: str, topic: str) -> str:
+    """Replace each whole level equal to `var` (e.g. '%c') with `val`."""
+    return join(val if w == var else w for w in tokens(topic))
+
+
+def systop(name: str, node: str = "emqx_tpu@127.0.0.1") -> str:
+    return f"$SYS/brokers/{node}/{name}"

@@ -1,0 +1,15 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import.
+
+Multi-chip shardings are validated on virtual CPU devices (the driver
+separately dry-runs `__graft_entry__.dryrun_multichip`); the real-TPU path is
+exercised by bench.py only.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

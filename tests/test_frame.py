@@ -1,0 +1,301 @@
+"""MQTT codec tests: golden wire vectors + randomized roundtrip properties.
+
+Mirrors the reference test strategy: emqx_frame_SUITE golden cases +
+prop_emqx_frame serialize/parse roundtrip property.
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import FrameError, FrameParser, serialize
+from emqx_tpu.mqtt.packet import (
+    Auth, Connack, Connect, Disconnect, Pingreq, Pingresp, Puback, Pubcomp,
+    Publish, Pubrec, Pubrel, SubOpts, Subscribe, Suback, Unsuback,
+    Unsubscribe, Will,
+)
+
+
+def roundtrip(pkt, version):
+    wire = serialize(pkt, version)
+    p = FrameParser(version=None if pkt.type == C.CONNECT else version)
+    out = p.feed(wire)
+    assert len(out) == 1, f"expected 1 packet, got {out}"
+    assert p.pending_bytes == 0
+    return out[0]
+
+
+class TestGoldenVectors:
+    def test_connect_v4_wire(self):
+        # hand-checked v3.1.1 CONNECT: clientid "c", clean, keepalive 60
+        pkt = Connect(proto_ver=C.MQTT_V4, clientid="c", keepalive=60,
+                      clean_start=True)
+        wire = serialize(pkt, C.MQTT_V4)
+        assert wire == bytes([
+            0x10, 13,               # CONNECT, remaining len
+            0, 4, 0x4D, 0x51, 0x54, 0x54,  # "MQTT"
+            4,                       # level
+            0x02,                    # clean start
+            0, 60,                   # keepalive
+            0, 1, ord("c"),          # clientid
+        ])
+
+    def test_pingreq_wire(self):
+        assert serialize(Pingreq(), C.MQTT_V4) == b"\xc0\x00"
+        assert serialize(Pingresp(), C.MQTT_V4) == b"\xd0\x00"
+
+    def test_publish_qos0_wire(self):
+        wire = serialize(Publish(topic="a/b", payload=b"hi"), C.MQTT_V4)
+        assert wire == b"\x30\x07\x00\x03a/bhi"
+
+    def test_publish_qos1_flags(self):
+        wire = serialize(Publish(topic="t", payload=b"", qos=1, packet_id=7,
+                                 retain=True, dup=True), C.MQTT_V4)
+        assert wire[0] == 0x30 | 0x8 | 0x2 | 0x1
+
+    def test_suback_v3(self):
+        wire = serialize(Suback(packet_id=3, reason_codes=[0, 1, 0x80]), C.MQTT_V4)
+        assert wire == b"\x90\x05\x00\x03\x00\x01\x80"
+
+
+class TestConnect:
+    def test_v5_roundtrip_full(self):
+        pkt = Connect(
+            proto_ver=C.MQTT_V5, clientid="client-1", keepalive=30,
+            clean_start=False, username="u", password=b"secret",
+            will=Will(topic="w/t", payload=b"bye", qos=1, retain=True,
+                      properties={"will_delay_interval": 5}),
+            properties={"session_expiry_interval": 3600,
+                        "receive_maximum": 20,
+                        "user_property": [("k", "v"), ("k2", "v2")]},
+        )
+        out = roundtrip(pkt, C.MQTT_V5)
+        assert out == pkt
+
+    def test_v3_roundtrip(self):
+        pkt = Connect(proto_ver=C.MQTT_V3, proto_name="MQIsdp", clientid="abc",
+                      keepalive=10)
+        out = roundtrip(pkt, C.MQTT_V3)
+        assert out.proto_ver == C.MQTT_V3
+        assert out.clientid == "abc"
+
+    def test_parser_learns_version(self):
+        p = FrameParser()
+        p.feed(serialize(Connect(proto_ver=C.MQTT_V5, clientid="x"), C.MQTT_V5))
+        assert p.version == C.MQTT_V5
+
+    def test_bad_protocol_name(self):
+        wire = bytearray(serialize(Connect(clientid="x"), C.MQTT_V4))
+        wire[4] = ord("X")  # corrupt proto name
+        with pytest.raises(FrameError):
+            FrameParser().feed(bytes(wire))
+
+    def test_reserved_flag_rejected(self):
+        pkt = serialize(Connect(clientid="x"), C.MQTT_V4)
+        wire = bytearray(pkt)
+        wire[9] |= 0x01  # set reserved connect flag
+        with pytest.raises(FrameError):
+            FrameParser().feed(bytes(wire))
+
+
+class TestPublish:
+    def test_qos3_rejected(self):
+        wire = bytearray(serialize(Publish(topic="t", qos=1, packet_id=1), C.MQTT_V4))
+        wire[0] = 0x30 | 0x6  # qos 3
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V4).feed(bytes(wire))
+
+    def test_packet_id_zero_rejected(self):
+        wire = b"\x32\x06\x00\x01t\x00\x00z"
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V4).feed(wire)
+
+    def test_v5_properties(self):
+        pkt = Publish(topic="t", payload=b"x", qos=1, packet_id=9,
+                      properties={"message_expiry_interval": 60,
+                                  "topic_alias": 3,
+                                  "correlation_data": b"\x01\x02",
+                                  "response_topic": "r/t"})
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+
+class TestStreamingParse:
+    def test_byte_at_a_time(self):
+        pkt = Publish(topic="stream/topic", payload=b"p" * 300, qos=1, packet_id=5)
+        wire = serialize(pkt, C.MQTT_V4)
+        p = FrameParser(version=C.MQTT_V4)
+        got = []
+        for i in range(len(wire)):
+            got += p.feed(wire[i:i + 1])
+        assert got == [pkt]
+
+    def test_multiple_packets_one_segment(self):
+        pkts = [Publish(topic="a", payload=b"1"), Pingreq(),
+                Publish(topic="b", payload=b"2", qos=2, packet_id=3)]
+        wire = b"".join(serialize(x, C.MQTT_V4) for x in pkts)
+        assert FrameParser(version=C.MQTT_V4).feed(wire) == pkts
+
+    def test_split_varint_header(self):
+        # remaining length 321 → 2-byte varint, split between feeds
+        pkt = Publish(topic="t", payload=b"z" * 318)
+        wire = serialize(pkt, C.MQTT_V4)
+        p = FrameParser(version=C.MQTT_V4)
+        assert p.feed(wire[:2]) == []
+        assert p.feed(wire[2:]) == [pkt]
+
+    def test_frame_too_large(self):
+        p = FrameParser(version=C.MQTT_V4, max_size=100)
+        wire = serialize(Publish(topic="t", payload=b"x" * 200), C.MQTT_V4)
+        with pytest.raises(FrameError) as e:
+            p.feed(wire)
+        assert e.value.code == "frame_too_large"
+
+
+class TestAckPackets:
+    @pytest.mark.parametrize("cls", [Puback, Pubrec, Pubrel, Pubcomp])
+    def test_v4(self, cls):
+        assert roundtrip(cls(packet_id=42), C.MQTT_V4) == cls(packet_id=42)
+
+    @pytest.mark.parametrize("cls", [Puback, Pubrec, Pubrel, Pubcomp])
+    def test_v5_with_rc(self, cls):
+        pkt = cls(packet_id=42, reason_code=C.RC_NO_MATCHING_SUBSCRIBERS,
+                  properties={"reason_string": "nobody"})
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+    def test_v5_short_form(self):
+        # rc omitted on wire → success
+        out = FrameParser(version=C.MQTT_V5).feed(b"\x40\x02\x00\x07")
+        assert out == [Puback(packet_id=7)]
+
+
+class TestSubUnsub:
+    def test_subscribe_v5(self):
+        pkt = Subscribe(packet_id=1,
+                        filters=[("a/+", SubOpts(qos=1, nl=1, rap=1, rh=2)),
+                                 ("b/#", SubOpts(qos=2))],
+                        properties={"subscription_identifier": [99]})
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+    def test_subscribe_v4_qos_only(self):
+        pkt = Subscribe(packet_id=1, filters=[("t", SubOpts(qos=1))])
+        assert roundtrip(pkt, C.MQTT_V4) == pkt
+
+    def test_empty_subscribe_rejected(self):
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V4).feed(b"\x82\x02\x00\x01")
+
+    def test_unsubscribe(self):
+        pkt = Unsubscribe(packet_id=5, filters=["a/b", "c/+"])
+        assert roundtrip(pkt, C.MQTT_V4) == pkt
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+    def test_unsuback_v5(self):
+        pkt = Unsuback(packet_id=5, reason_codes=[0, 0x11])
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+
+class TestDisconnectAuth:
+    def test_disconnect_v4(self):
+        assert serialize(Disconnect(), C.MQTT_V4) == b"\xe0\x00"
+
+    def test_disconnect_v5_rc(self):
+        pkt = Disconnect(reason_code=C.RC_SESSION_TAKEN_OVER,
+                         properties={"reason_string": "takeover"})
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+    def test_disconnect_v5_empty_body(self):
+        out = FrameParser(version=C.MQTT_V5).feed(b"\xe0\x00")
+        assert out == [Disconnect(reason_code=C.RC_NORMAL_DISCONNECTION)]
+
+    def test_auth(self):
+        pkt = Auth(reason_code=C.RC_CONTINUE_AUTHENTICATION,
+                   properties={"authentication_method": "SCRAM-SHA-1",
+                               "authentication_data": b"\x00\x01"})
+        assert roundtrip(pkt, C.MQTT_V5) == pkt
+
+
+class TestStrictViolations:
+    """Regressions for strict-mode checks (parity: emqx_frame validate paths)."""
+
+    def test_puback_packet_id_zero(self):
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V4).feed(b"\x40\x02\x00\x00")
+
+    def test_subscribe_packet_id_zero(self):
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V4).feed(b"\x82\x06\x00\x00\x00\x01t\x01")
+
+    def test_puback_trailing_bytes_rejected(self):
+        with pytest.raises(FrameError):
+            FrameParser(version=C.MQTT_V5).feed(b"\x40\x05\x00\x07\x10\x00\xff")
+
+    def test_bad_property_value_raises_frame_error(self):
+        with pytest.raises(FrameError):
+            serialize(Publish(topic="t", properties={"topic_alias": [1, 2]}),
+                      C.MQTT_V5)
+
+    def test_large_frame_streams_linearly(self):
+        # one 4MB publish fed in 16KB chunks parses without quadratic blowup
+        pkt = Publish(topic="big", payload=b"x" * (4 << 20))
+        wire = serialize(pkt, C.MQTT_V4)
+        p = FrameParser(version=C.MQTT_V4)
+        got = []
+        for i in range(0, len(wire), 16384):
+            got += p.feed(wire[i:i + 16384])
+        assert got == [pkt]
+
+
+def _rand_topic(rng):
+    return "/".join(
+        rng.choice(["a", "bb", "ccc", "dev", ""])
+        for _ in range(rng.randint(1, 6))) or "x"
+
+
+def _rand_props(rng):
+    opts = {
+        "message_expiry_interval": rng.randint(0, 2**32 - 1),
+        "content_type": "text/plain",
+        "user_property": [("a", "b")],
+        "payload_format_indicator": rng.randint(0, 1),
+    }
+    return {k: opts[k] for k in rng.sample(sorted(opts), rng.randint(0, len(opts)))}
+
+
+class TestRoundtripProperty:
+    """Randomized serialize→parse == identity (mirrors prop_emqx_frame)."""
+
+    def test_random_publishes(self):
+        rng = random.Random(1234)
+        for version in (C.MQTT_V4, C.MQTT_V5):
+            for _ in range(200):
+                qos = rng.randint(0, 2)
+                pkt = Publish(
+                    topic=_rand_topic(rng),
+                    payload=rng.randbytes(rng.randint(0, 64)),
+                    qos=qos,
+                    packet_id=rng.randint(1, 0xFFFF) if qos else None,
+                    retain=rng.random() < 0.5,
+                    dup=rng.random() < 0.5 and qos > 0,
+                    properties=_rand_props(rng) if version == C.MQTT_V5 else {},
+                )
+                assert roundtrip(pkt, version) == pkt
+
+    def test_random_stream_fragmentation(self):
+        rng = random.Random(99)
+        pkts = []
+        wire = b""
+        for _ in range(50):
+            qos = rng.randint(0, 2)
+            pkt = Publish(topic=_rand_topic(rng), payload=rng.randbytes(rng.randint(0, 2000)),
+                          qos=qos, packet_id=rng.randint(1, 0xFFFF) if qos else None)
+            pkts.append(pkt)
+            wire += serialize(pkt, C.MQTT_V4)
+        p = FrameParser(version=C.MQTT_V4)
+        got = []
+        i = 0
+        while i < len(wire):
+            n = rng.randint(1, 700)
+            got += p.feed(wire[i:i + n])
+            i += n
+        assert got == pkts

@@ -1,0 +1,155 @@
+"""Topic algebra tests (parity oracle: reference emqx_topic.erl + its SUITE)."""
+
+import pytest
+
+from emqx_tpu.utils import topic as T
+
+
+class TestWords:
+    def test_tokens(self):
+        assert T.tokens("a/b/c") == ["a", "b", "c"]
+        assert T.tokens("/a") == ["", "a"]
+        assert T.tokens("a//b") == ["a", "", "b"]
+        assert T.tokens("a/b/") == ["a", "b", ""]
+        assert T.tokens("") == [""]
+
+    def test_levels(self):
+        assert T.levels("a/b/c") == 3
+        assert T.levels("/") == 2
+
+
+class TestWildcard:
+    def test_wildcard(self):
+        assert T.wildcard("a/+/b")
+        assert T.wildcard("a/b/#")
+        assert T.wildcard("#")
+        assert not T.wildcard("a/b/c")
+        assert not T.wildcard("a/b+c")  # '+' inside a word is not a wildcard
+        assert not T.wildcard("")
+
+
+class TestMatch:
+    # positive cases mirrored from the reference topic SUITE semantics
+    @pytest.mark.parametrize("name,filt", [
+        ("a/b/c", "a/b/c"),
+        ("a/b/c", "a/+/c"),
+        ("a/b/c", "a/#"),
+        ("a/b/c", "#"),
+        ("a/b/c", "+/+/+"),
+        ("a", "a/#"),          # '#' matches zero levels
+        ("a/b", "a/b/#"),
+        ("a", "+"),
+        ("/", "+/+"),          # empty levels are levels
+        ("/a", "+/a"),
+        ("a//b", "a/+/b"),
+        ("a/", "a/+"),
+        ("$SYS/broker", "$SYS/#"),      # '$' only excluded at root
+        ("$SYS/broker", "$SYS/+"),
+        ("$SYS", "$SYS/#"),             # sport/# matches sport, same for $SYS
+        ("a/$b/c", "a/+/c"),            # mid-level '$' is ordinary
+        ("a/$b/c", "a/#"),
+    ])
+    def test_match_true(self, name, filt):
+        assert T.match(name, filt)
+
+    @pytest.mark.parametrize("name,filt", [
+        ("a/b/c", "a/b"),
+        ("a/b", "a/b/c"),
+        ("a/b", "a/b/+"),
+        ("a/b/c", "a/+"),
+        ("b/c", "a/#"),
+        ("a", "b"),
+        ("$SYS/broker", "#"),    # root wildcard excluded for $-topics
+        ("$SYS/broker", "+/broker"),
+        ("$SYS", "#"),
+        ("$SYS", "+"),
+        ("", "a"),
+    ])
+    def test_match_false(self, name, filt):
+        assert not T.match(name, filt)
+
+    def test_match_words_no_dollar_rule(self):
+        # word-list form bypasses the root '$' exclusion (caller's concern)
+        assert T.match_words(["$SYS", "b"], ["#"])
+
+
+class TestValidate:
+    @pytest.mark.parametrize("t", [
+        "a/b/c", "+", "#", "a/+/#", "+/+", "/", "a//b", "a/b/", "$SYS/#",
+        "a" * 65535,
+    ])
+    def test_valid_filters(self, t):
+        assert T.validate(t, "filter")
+
+    @pytest.mark.parametrize("t,code", [
+        ("", "empty_topic"),
+        ("a/" * 40000, "topic_too_long"),
+        ("a/#/b", "topic_invalid_#"),
+        ("#/b", "topic_invalid_#"),
+        ("a/b+c/d", "topic_invalid_char"),
+        ("a/b#/d", "topic_invalid_char"),
+        ("a/+b", "topic_invalid_char"),
+        ("a/\x00b", "topic_invalid_char"),
+    ])
+    def test_invalid_filters(self, t, code):
+        with pytest.raises(T.TopicError) as e:
+            T.validate(t, "filter")
+        assert e.value.code == code
+
+    @pytest.mark.parametrize("t", ["a/+/b", "#", "a/#"])
+    def test_name_rejects_wildcards(self, t):
+        with pytest.raises(T.TopicError) as e:
+            T.validate(t, "name")
+        assert e.value.code == "topic_name_error"
+
+    def test_name_valid(self):
+        assert T.validate("a/b/c", "name")
+
+
+class TestParse:
+    def test_plain(self):
+        assert T.parse("a/b") == ("a/b", {})
+
+    def test_share(self):
+        assert T.parse("$share/g1/a/b") == ("a/b", {"share": "g1"})
+
+    def test_share_deep(self):
+        assert T.parse("$share/g/t/+/#") == ("t/+/#", {"share": "g"})
+
+    def test_queue(self):
+        assert T.parse("$queue/a/b") == ("a/b", {"share": "$queue"})
+
+    @pytest.mark.parametrize("t", [
+        "$share/g",              # no filter part
+        "$share/g+/t",           # wildcard in group
+        "$share/g#/t",
+    ])
+    def test_invalid_share(self, t):
+        with pytest.raises(T.TopicError):
+            T.parse(t)
+
+    def test_nested_share_invalid(self):
+        with pytest.raises(T.TopicError):
+            T.parse("$share/g/$share/h/t")
+        with pytest.raises(T.TopicError):
+            T.parse("$queue/$share/h/t")
+
+
+class TestHelpers:
+    def test_join(self):
+        assert T.join(["a", "b", "c"]) == "a/b/c"
+        assert T.join(["", "a"]) == "/a"
+        assert T.join([]) == ""
+
+    def test_prepend(self):
+        assert T.prepend(None, "t") == "t"
+        assert T.prepend("", "t") == "t"
+        assert T.prepend("mnt", "t") == "mnt/t"
+        assert T.prepend("mnt/", "t") == "mnt/t"
+
+    def test_feed_var(self):
+        assert T.feed_var("%c", "cid1", "client/%c/up") == "client/cid1/up"
+        assert T.feed_var("%u", "u", "a/b") == "a/b"
+
+    def test_systop(self):
+        assert T.systop("version", node="n1") == "$SYS/brokers/n1/version"

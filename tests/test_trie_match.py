@@ -1,0 +1,194 @@
+"""Trie/NFA equivalence tests.
+
+Oracle chain (mirrors reference emqx_trie tests, where emqx_topic:match/2 is
+the oracle for emqx_trie:match/1): brute-force `topic.match` over all filters
+== HostTrie.match == device match_batch, over randomized filter/topic sets.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.ops import intern as I
+from emqx_tpu.ops.match import encode_topics, match_batch
+from emqx_tpu.ops.trie import HostTrie, build_tables
+from emqx_tpu.utils import topic as T
+
+WORDS = ["a", "b", "c", "dev", "x1", "$sys", ""]
+
+
+def rand_filter(rng, max_levels=6):
+    n = rng.randint(1, max_levels)
+    ws = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.2:
+            ws.append("+")
+        elif r < 0.3 and i == n - 1:
+            ws.append("#")
+        else:
+            ws.append(rng.choice(WORDS))
+    return "/".join(ws)
+
+
+def rand_topic(rng, max_levels=6):
+    n = rng.randint(1, max_levels)
+    return "/".join(rng.choice(WORDS) for _ in range(n))
+
+
+def brute_force(topic, filters):
+    return sorted(fid for fid, f in enumerate(filters) if T.match(topic, f))
+
+
+class Fixture:
+    """Interns a filter list, builds HostTrie + TrieTables."""
+
+    def __init__(self, filters, max_levels=8):
+        self.filters = filters
+        self.intern = I.InternTable()
+        self.host = HostTrie()
+        self.max_levels = max_levels
+        rows = np.zeros((len(filters), max_levels), np.int32)
+        lens = np.zeros(len(filters), np.int64)
+        for fid, f in enumerate(filters):
+            wids = self.intern.encode_filter(T.words(f))
+            assert len(wids) <= max_levels
+            self.host.insert(wids, fid)
+            rows[fid, :len(wids)] = wids
+            lens[fid] = len(wids)
+        self.tables = build_tables(rows, lens)
+
+    def host_match(self, topic):
+        ws = T.words(topic)
+        return sorted(self.host.match(
+            self.intern.encode_topic(ws), is_dollar=ws[0].startswith("$")))
+
+    def device_match(self, topics, **caps):
+        tw = [T.words(t) for t in topics]
+        enc, lens, dollar, too_long = encode_topics(self.intern, tw, self.max_levels)
+        assert not too_long.any()
+        res = match_batch(self.tables, enc, lens, dollar, **caps)
+        out = []
+        for i in range(len(topics)):
+            assert not bool(res.overflow[i]), f"overflow on {topics[i]}"
+            out.append(sorted(int(x) for x in res.matches[i][:int(res.counts[i])]))
+        return out
+
+
+BASIC_FILTERS = [
+    "a/b/c",        # 0 exact
+    "a/+/c",        # 1
+    "a/#",          # 2
+    "#",            # 3
+    "+/+/+",        # 4
+    "+",            # 5
+    "a",            # 6
+    "$sys/#",       # 7
+    "$sys/+",       # 8
+    "a/b/#",        # 9
+    "+/b/c",        # 10
+    "a/b",          # 11
+    "/+",           # 12
+    "+/a",          # 13
+]
+
+
+class TestHostTrie:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return Fixture(BASIC_FILTERS)
+
+    @pytest.mark.parametrize("topic", [
+        "a/b/c", "a", "a/b", "x", "/a", "/x", "$sys", "$sys/a", "$sys/a/b",
+        "a/x/c", "a/b/c/d", "", "x/y/z", "x/a",
+    ])
+    def test_matches_brute_force(self, fx, topic):
+        assert fx.host_match(topic) == brute_force(topic, BASIC_FILTERS)
+
+    def test_delete(self):
+        fx = Fixture(["a/+", "a/b"])
+        wids = fx.intern.encode_filter(["a", "+"])
+        fx.host.delete(wids)
+        assert fx.host_match("a/b") == [1]
+        fx.host.delete(fx.intern.encode_filter(["a", "b"]))
+        assert fx.host_match("a/b") == []
+        assert fx.host.is_empty()
+
+    def test_delete_keeps_shared_prefix(self):
+        fx = Fixture(["a/b/c", "a/b"])
+        fx.host.delete(fx.intern.encode_filter(["a", "b"]))
+        assert fx.host_match("a/b/c") == [0]
+        assert fx.host_match("a/b") == []
+
+
+class TestDeviceMatch:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return Fixture(BASIC_FILTERS)
+
+    @pytest.mark.parametrize("topic", [
+        "a/b/c", "a", "a/b", "x", "/a", "/x", "$sys", "$sys/a", "$sys/a/b",
+        "a/x/c", "a/b/c/d", "", "x/y/z", "x/a", "unseen/words/here",
+    ])
+    def test_matches_brute_force(self, fx, topic):
+        got = fx.device_match([topic])[0]
+        assert got == brute_force(topic, BASIC_FILTERS), topic
+
+    def test_batch(self, fx):
+        topics = ["a/b/c", "x", "$sys/a", "a", "/a"]
+        got = fx.device_match(topics)
+        assert got == [brute_force(t, BASIC_FILTERS) for t in topics]
+
+    def test_batch_padding_rows(self, fx):
+        # lens == 0 rows must produce nothing (not even '#')
+        enc = np.zeros((3, fx.max_levels), np.int32)
+        lens = np.zeros(3, np.int32)
+        dollar = np.zeros(3, bool)
+        res = match_batch(fx.tables, enc, lens, dollar)
+        assert int(res.counts.sum()) == 0
+        assert not bool(res.overflow.any())
+
+    def test_empty_trie(self):
+        fx = Fixture([])
+        assert fx.device_match(["a/b"]) == [[]]
+
+    def test_match_cap_overflow_flag(self):
+        filters = [f"a/{i}/#"[:-2] + "#" for i in range(8)]  # a/i/#
+        filters += ["a/+/+", "#", "a/#"]
+        fx = Fixture(filters)
+        tw = [T.words("a/3/z")]
+        enc, lens, dollar, _ = encode_topics(fx.intern, tw, fx.max_levels)
+        res = match_batch(fx.tables, enc, lens, dollar, match_cap=2)
+        assert bool(res.overflow[0])
+
+
+class TestRandomizedEquivalence:
+    @pytest.mark.parametrize("seed", [7, 21, 42, 1001])
+    def test_random_sets(self, seed):
+        rng = random.Random(seed)
+        filters = sorted({rand_filter(rng) for _ in range(rng.randint(5, 120))})
+        fx = Fixture(filters)
+        topics = [rand_topic(rng) for _ in range(64)]
+        want = [brute_force(t, filters) for t in topics]
+        assert [fx.host_match(t) for t in topics] == want
+        got = fx.device_match(topics, frontier_cap=32, match_cap=128)
+        assert got == want
+
+    def test_deep_topics(self):
+        rng = random.Random(5)
+        filters = ["+/+/+/+/+/+/+/+", "a/#", "a/a/a/a/a/a/a/a", "#",
+                   "a/+/a/+/a/+/a/+"]
+        fx = Fixture(filters)
+        topics = ["/".join(rng.choice(["a", "b"]) for _ in range(8))
+                  for _ in range(32)]
+        got = fx.device_match(topics, frontier_cap=32)
+        assert got == [brute_force(t, filters) for t in topics]
+
+    def test_bench_shape_filters(self):
+        # the reference bench shape: device/{{id}}/+/{{num}}/# (broker_bench.erl:25-34)
+        filters = [f"device/{i}/+/{n}/#" for i in range(8) for n in range(16)]
+        fx = Fixture(filters)
+        topics = [f"device/{i}/x/{n}/tail" for i in range(8) for n in range(16)]
+        got = fx.device_match(topics)
+        assert got == [brute_force(t, filters) for t in topics]
