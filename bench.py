@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""North-star benchmark: wildcard topic-match + fan-out throughput on TPU.
+
+Measures the fused route step (NFA match + subscriber fan-out + shared-sub
+selection) against the BASELINE.md target: >=5M topic-matches/sec at 10M
+wildcard subscriptions on one v5e-1, p99 < 2ms.
+
+Filter shape mirrors the reference's broker_bench
+(emqx_broker_bench.erl:25-34 `device/{{id}}/+/{{num}}/#`), scaled to
+BENCH_SUBS subscriptions; BENCH_SHARED_PCT puts that share of subscriptions
+into $share groups (config 4 of BASELINE.md).
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+
+Env knobs: BENCH_SUBS (default 10_000_000), BENCH_BATCH (8192),
+BENCH_ITERS (50), BENCH_SHARED_PCT (50).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    subs = int(os.environ.get("BENCH_SUBS", 10_000_000))
+    B = int(os.environ.get("BENCH_BATCH", 8192))
+    iters = int(os.environ.get("BENCH_ITERS", 50))
+    shared_pct = int(os.environ.get("BENCH_SHARED_PCT", 50))
+
+    import jax
+
+    from emqx_tpu.models.router_engine import RouterTables, route_step
+    from emqx_tpu.ops import intern as I
+    from emqx_tpu.ops.fanout import SubTable
+    from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+    from emqx_tpu.ops.trie import build_tables
+
+    log(f"bench: subs={subs} batch={B} iters={iters} shared={shared_pct}% "
+        f"device={jax.devices()[0]}")
+
+    # --- build the filter set: device/{id}/+/{num}/#  -------------------
+    ids = max(64, int(np.sqrt(subs)))
+    nums = max(1, subs // ids)
+    F = ids * nums
+    intern = I.InternTable()
+    wd = intern.intern("device")
+    id_ids = np.array([intern.intern(f"d{i}") for i in range(ids)], np.int32)
+    num_ids = np.array([intern.intern(f"n{n}") for n in range(nums)], np.int32)
+    rows = np.zeros((F, 8), np.int32)
+    lens = np.full(F, 5, np.int64)
+    rows[:, 0] = wd
+    rows[:, 1] = np.repeat(id_ids, nums)
+    rows[:, 2] = I.PLUS
+    rows[:, 3] = np.tile(num_ids, ids)
+    rows[:, 4] = I.HASH
+
+    t0 = time.time()
+    trie = build_tables(rows, lens)
+    t_build = time.time() - t0
+    log(f"trie build: {t_build:.1f}s, nodes={int(trie.num_nodes)}, "
+        f"edges={int(trie.num_edges)}, slots={trie.slot_parent.shape[0]}")
+
+    # --- subscriber table: one subscriber per filter; a slice of filters
+    # also belongs to shared groups (one 8-member group per 16 filters) ----
+    n_shared_filters = F * shared_pct // 100
+    sub_start = np.arange(F + 1, dtype=np.int32)
+    sub_row = np.arange(F, dtype=np.int32)
+    sub_opts = np.ones(F, np.int32)
+    group_of = np.arange(n_shared_filters, dtype=np.int32) // 16
+    n_groups = max(1, int(group_of.max(initial=0)) + 1)
+    fs_start = np.zeros(F + 1, np.int32)
+    fs_start[1:n_shared_filters + 1] = 1
+    np.cumsum(fs_start, out=fs_start)
+    fs_slot = group_of if n_shared_filters else np.full(1, -1, np.int32)
+    shared_start = np.arange(n_groups + 1, dtype=np.int32) * 8
+    shared_row = F + np.arange(n_groups * 8, dtype=np.int32)
+    shared_opts = np.ones(n_groups * 8, np.int32)
+    subs_tbl = SubTable(sub_start, sub_row, sub_opts, fs_start, fs_slot,
+                        shared_start, shared_row, shared_opts)
+
+    t0 = time.time()
+    tables = jax.device_put(RouterTables(trie=trie, subs=subs_tbl))
+    jax.block_until_ready(tables)
+    log(f"upload: {time.time() - t0:.1f}s")
+    cursors = jax.device_put(np.zeros(n_groups, np.int32))
+    strat = jax.device_put(np.int32(STRATEGY_ROUND_ROBIN))
+    jax.block_until_ready((cursors, strat))
+
+    # --- pre-staged publish batches (Zipf-ish skew over device ids) ------
+    x = intern.intern("x")
+    tail = intern.intern("t")
+    rng = np.random.RandomState(7)
+    zipf = np.minimum(rng.zipf(1.3, size=(8, B)) - 1, ids - 1)
+    batches = []
+    for k in range(8):
+        tp = np.zeros((B, 8), np.int32)
+        tp[:, 0] = wd
+        tp[:, 1] = id_ids[zipf[k]]
+        tp[:, 2] = x
+        tp[:, 3] = num_ids[rng.randint(0, nums, B)]
+        tp[:, 4] = tail
+        b = (jax.device_put(tp), jax.device_put(np.full(B, 5, np.int32)),
+             jax.device_put(np.zeros(B, bool)),
+             jax.device_put(rng.randint(0, 1 << 30, B).astype(np.int32)))
+        batches.append(b)
+    jax.block_until_ready(batches)
+
+    def step(batch, cur):
+        return route_step(tables, cur, *batch, strat, frontier_cap=8,
+                          match_cap=8, fanout_cap=16, slot_cap=4)
+
+    # warmup / compile
+    r = step(batches[0], cursors)
+    jax.block_until_ready(r)
+    log(f"sanity: matches={int(np.asarray(r.match_counts).sum())}/{B}, "
+        f"fan={int(np.asarray(r.fan_counts).sum())}, "
+        f"shared={int((np.asarray(r.shared_rows) >= 0).sum())}, "
+        f"overflow={int(np.asarray(r.overflow).sum())}")
+
+    # timed: blocked per call → latency distribution & honest throughput
+    lat = []
+    cur = cursors
+    for i in range(iters):
+        b = batches[i % len(batches)]
+        t0 = time.time()
+        r = step(b, cur)
+        jax.block_until_ready(r)
+        lat.append(time.time() - t0)
+        cur = r.new_cursors
+    lat = np.array(sorted(lat))
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+    matches_per_sec = B / p50
+    log(f"latency p50={p50 * 1000:.3f}ms p99={p99 * 1000:.3f}ms "
+        f"({iters} iters, batch {B})")
+    log(f"throughput={matches_per_sec / 1e6:.1f}M topic-matches/s")
+
+    target = 5_000_000.0
+    print(json.dumps({
+        "metric": f"topic_matches_per_sec_at_{subs // 1_000_000}M_subs",
+        "value": round(matches_per_sec),
+        "unit": "topic-matches/s",
+        "vs_baseline": round(matches_per_sec / target, 2),
+        "p50_ms": round(p50 * 1000, 3),
+        "p99_ms": round(p99 * 1000, 3),
+        "batch": B,
+        "subs": subs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""The flagship device program: one fused PUBLISH route step.
+
+This is the TPU replacement for the reference broker's per-message hot path
+(emqx_broker:publish/1 → emqx_router:match_routes → emqx_trie:match →
+dispatch fold, emqx_broker.erl:199-308): for a whole micro-batch of publishes
+it runs, in one jitted program,
+
+  1. wildcard NFA match over the compiled trie        (ops.match)
+  2. normal-subscriber fan-out segment-gather         (ops.fanout)
+  3. shared-subscription member selection + cursors   (ops.shared)
+
+State model: `RouterTables` is immutable (rebuilt/double-buffered by the host
+router on subscription churn); `cursors` is the only mutable device state and
+is threaded functionally through each step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.ops.fanout import FanoutResult, SubTable, fanout_normal, shared_slots
+from emqx_tpu.ops.match import MatchResult, match_batch
+from emqx_tpu.ops.shared import SharedPickResult, pick_members
+from emqx_tpu.ops.trie import TrieTables
+
+
+class RouterTables(NamedTuple):
+    """All device-resident routing state except shared-sub cursors."""
+    trie: TrieTables
+    subs: SubTable
+
+
+class RouteResult(NamedTuple):
+    matches: jax.Array        # [B, M] matched filter ids
+    match_counts: jax.Array   # [B]
+    rows: jax.Array           # [B, D] normal delivery session rows
+    opts: jax.Array           # [B, D] packed subopts
+    fan_counts: jax.Array     # [B]
+    shared_rows: jax.Array    # [B, K] shared picks (session rows)
+    shared_opts: jax.Array    # [B, K]
+    overflow: jax.Array       # [B] any capacity overflow → host fallback
+    new_cursors: jax.Array    # [G]
+    occur: jax.Array          # [G] shared-slot occurrences this batch
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap", "slot_cap"))
+def route_step(tables: RouterTables, cursors: jax.Array, topics: jax.Array,
+               lens: jax.Array, is_dollar: jax.Array, msg_hash: jax.Array,
+               strategy: jax.Array, *, frontier_cap: int = 16,
+               match_cap: int = 64, fanout_cap: int = 128,
+               slot_cap: int = 16) -> RouteResult:
+    """Route a micro-batch of publishes: match + fan-out + shared picks."""
+    mr: MatchResult = match_batch(
+        tables.trie, topics, lens, is_dollar,
+        frontier_cap=frontier_cap, match_cap=match_cap)
+    fr: FanoutResult = fanout_normal(tables.subs, mr.matches,
+                                     fanout_cap=fanout_cap)
+    sids, slot_oflow = shared_slots(tables.subs, mr.matches, slot_cap=slot_cap)
+    sp: SharedPickResult = pick_members(tables.subs, cursors, sids, strategy,
+                                        msg_hash)
+    overflow = mr.overflow | fr.overflow | slot_oflow
+    return RouteResult(
+        matches=mr.matches, match_counts=mr.counts,
+        rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
+        shared_rows=sp.rows, shared_opts=sp.opts,
+        overflow=overflow, new_cursors=sp.new_cursors, occur=sp.occur)
+
+
+def empty_router_tables(filter_cap: int = 16) -> RouterTables:
+    """A valid all-empty RouterTables (useful before first build)."""
+    from emqx_tpu.ops.fanout import build_subtable
+    from emqx_tpu.ops.trie import build_tables
+    trie = build_tables(np.zeros((0, 1), np.int32), np.zeros(0, np.int64))
+    subs = build_subtable(filter_cap, {}, {}, {})
+    return RouterTables(trie=trie, subs=subs)

@@ -1,0 +1,103 @@
+"""Shared-subscription member selection on device.
+
+The reference picks one group member per message with pluggable strategies
+(emqx_shared_sub.erl:239-290 — random, round_robin, sticky, hash_clientid,
+hash_topic; round_robin keeps a per-group counter in the worker's process
+dictionary). Here selection is *batched and deterministic*: each (group,
+filter) pair is a dense "shared slot" with a persistent cursor; for a batch
+of messages, every occurrence of a slot gets successive cursor offsets in
+batch order (an associative rank-over-equal-slots computed by sort — SURVEY
+§7 hard-part 4), so round-robin semantics hold within and across batches
+with no sequential loop.
+
+Strategies round_robin / random / hash_* map onto the same primitive by
+choosing the base offset (cursor, message hash) — see pick_members.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from emqx_tpu.ops.fanout import SubTable
+
+STRATEGY_ROUND_ROBIN = 0
+STRATEGY_RANDOM = 1       # pseudo-random: hash of (msg seed, slot)
+STRATEGY_HASH_TOPIC = 2   # stable per topic-hash
+STRATEGY_HASH_CLIENT = 3  # stable per publisher-hash
+STRATEGIES = {
+    "round_robin": STRATEGY_ROUND_ROBIN,
+    "random": STRATEGY_RANDOM,
+    "hash_topic": STRATEGY_HASH_TOPIC,
+    "hash_clientid": STRATEGY_HASH_CLIENT,
+    # 'sticky' is host-side (needs per-consumer affinity state, rare path)
+}
+
+
+class SharedPickResult(NamedTuple):
+    rows: jax.Array         # [B, K] picked member session row, -1 pad
+    opts: jax.Array         # [B, K] packed subopts of picked member
+    new_cursors: jax.Array  # [G] updated round-robin cursors
+    occur: jax.Array        # [G] occurrences of each slot in this batch
+                            # (lets a data-parallel caller psum across shards
+                            # and rebase cursors consistently)
+
+
+def _rank_over_runs(sids: jax.Array) -> jax.Array:
+    """rank[b,k] = #occurrences of sids[b,k] earlier in flattened batch order.
+
+    -1 entries get rank 0 (unused). Stable sort keeps batch order within runs.
+    """
+    B, K = sids.shape
+    flat = sids.reshape(-1)
+    n = flat.shape[0]
+    order = jnp.argsort(flat, stable=True)
+    sorted_sids = flat[order]
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), sorted_sids[1:] != sorted_sids[:-1]])
+    pos = jnp.arange(n, dtype=jnp.int32)
+    start_pos = jnp.maximum.accumulate(jnp.where(is_start, pos, 0))
+    rank_sorted = pos - start_pos
+    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+    return rank.reshape(B, K)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def pick_members(table: SubTable, cursors: jax.Array, sids: jax.Array,
+                 strategy: jax.Array, msg_hash: jax.Array) -> SharedPickResult:
+    """Pick one member per matched shared slot, batched.
+
+    cursors: [G] persistent per-slot round-robin counters (device state).
+    sids: [B, K] matched shared-slot ids (-1 pad) from shared_slots().
+    strategy: scalar int32 (STRATEGY_*).
+    msg_hash: [B] int32 per-message hash (topic/publisher hash or seed),
+      used by random/hash strategies.
+    """
+    B, K = sids.shape
+    valid = sids >= 0
+    safe = jnp.clip(sids, 0)
+    lo = table.shared_start[safe]
+    size = table.shared_start[safe + 1] - lo  # [B, K] members per slot
+    nonempty = valid & (size > 0)
+
+    rank = _rank_over_runs(sids)
+    base_rr = cursors[safe] + rank
+    base_hash = (msg_hash[:, None].astype(jnp.uint32)
+                 * jnp.uint32(0x9E3779B1) ^ safe.astype(jnp.uint32)).astype(jnp.int32)
+    base = jnp.where(strategy == STRATEGY_ROUND_ROBIN, base_rr,
+                     jnp.abs(base_hash))
+    member = jnp.where(nonempty, base % jnp.maximum(size, 1), 0)
+    idx = lo + member
+    rows = jnp.where(nonempty, table.shared_row[jnp.clip(idx, 0)], -1)
+    opts = jnp.where(nonempty, table.shared_opts[jnp.clip(idx, 0)], 0)
+
+    # advance cursors by per-slot occurrence counts (round_robin only)
+    occur = jnp.zeros_like(cursors).at[safe.reshape(-1)].add(
+        valid.reshape(-1).astype(cursors.dtype), mode="drop")
+    new_cursors = jnp.where(strategy == STRATEGY_ROUND_ROBIN,
+                            cursors + occur, cursors)
+    return SharedPickResult(rows=rows, opts=opts, new_cursors=new_cursors,
+                            occur=occur)

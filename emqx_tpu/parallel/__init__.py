@@ -1,0 +1,10 @@
+"""Mesh + shard_map parallelism for the route engine.
+
+Mapping of the reference's distribution mechanisms (SURVEY.md §2.4) onto TPU
+mesh axes: filter space is sharded over the 'route' axis (each device holds a
+sub-trie of its filter subset — the analog of emqx's fully-replicated route
+table being read-locally, P4, but partitioned instead of replicated because
+HBM is the budget); publish batches shard over 'dp' (the {active,N} batching
+window, P10); intra-slice combination rides ICI via all_gather/psum instead
+of gen_rpc TCP channels (P6).
+"""

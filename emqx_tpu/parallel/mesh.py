@@ -1,0 +1,32 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
+              route: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('dp', 'route') mesh over the available devices.
+
+    Default split: all devices on 'route' (filter sharding) — the match NFA
+    is gather-bound, so partitioning the trie buys the most HBM headroom;
+    raise `dp` to shard the publish batch too.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    if dp is None and route is None:
+        dp, route = 1, n
+    elif dp is None:
+        dp = n // route
+    elif route is None:
+        route = n // dp
+    if dp * route != n:
+        raise ValueError(f"dp({dp}) * route({route}) != n_devices({n})")
+    return Mesh(np.asarray(devs).reshape(dp, route), ("dp", "route"))

@@ -1,0 +1,121 @@
+"""shard_map'ed route step: filter-sharded trie × batch-sharded publishes.
+
+Each 'route' shard owns a disjoint filter subset compiled into its own
+RouterTables (same array shapes, different contents — stacked on a leading
+axis). Publish batches shard over 'dp'. One step computes every (dp, route)
+pair's local matches/fan-out; shared-subscription round-robin cursors stay
+consistent across 'dp' shards by all-gathering per-slot occurrence counts
+and rebasing each shard's cursor offset by the occurrences of lower dp ranks
+(deterministic global batch order), then psum-advancing.
+
+This is the ICI data plane replacing the reference's gen_rpc cross-node
+forwarding (emqx_rpc.erl:20-60): instead of shipping messages to the node
+that owns the route, every shard matches its slice and results ride the
+interconnect (SURVEY.md §2.4 P6, §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from emqx_tpu.models.router_engine import RouterTables, RouteResult
+from emqx_tpu.ops.fanout import fanout_normal, shared_slots
+from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN, pick_members
+
+
+def stack_tables(tables_list: list) -> RouterTables:
+    """Stack per-shard RouterTables on a new leading axis (host, numpy).
+
+    All shards must share array shapes — build each with the same
+    node/slot/filter capacities (the host router buckets capacities pow2).
+    """
+    return jax.tree.map(lambda *xs: np.stack(xs), *tables_list)
+
+
+def put_sharded(mesh: Mesh, tables_stacked: RouterTables, cursors_stacked):
+    """Place stacked tables/cursors with their 'route' sharding."""
+    spec = NamedSharding(mesh, P("route"))
+    tables = jax.tree.map(lambda x: jax.device_put(x, spec), tables_stacked)
+    cursors = jax.device_put(cursors_stacked, spec)
+    return tables, cursors
+
+
+def make_sharded_route_step(mesh: Mesh, *, frontier_cap: int = 16,
+                            match_cap: int = 64, fanout_cap: int = 128,
+                            slot_cap: int = 16):
+    """Build the jitted multi-device route step for `mesh` ('dp','route').
+
+    Call signature of the returned fn:
+      step(tables [R,...], cursors [R,G], topics [B,L], lens [B],
+           is_dollar [B], msg_hash [B], strategy scalar) -> RouteResult
+    where per-topic outputs come back as [B, R, ...] (R = route shards,
+    local filter ids per shard) and cursors as [R, G].
+    """
+    dp_size = mesh.shape["dp"]
+
+    def local_step(tables, cursors, topics, lens, is_dollar, msg_hash,
+                   strategy):
+        tables = jax.tree.map(lambda x: x[0], tables)  # this shard's slice
+        cursors = cursors[0]
+
+        mr = match_batch(tables.trie, topics, lens, is_dollar,
+                         frontier_cap=frontier_cap, match_cap=match_cap)
+        fr = fanout_normal(tables.subs, mr.matches, fanout_cap=fanout_cap)
+        sids, slot_oflow = shared_slots(tables.subs, mr.matches,
+                                        slot_cap=slot_cap)
+
+        # cross-dp deterministic round-robin: rebase cursors by the
+        # occurrences seen in lower dp ranks, advance by the global total
+        occur_local = jnp.zeros_like(cursors).at[
+            jnp.clip(sids, 0).reshape(-1)].add(
+            (sids >= 0).reshape(-1).astype(cursors.dtype))
+        occur_all = jax.lax.all_gather(occur_local, "dp")        # [dp, G]
+        my_dp = jax.lax.axis_index("dp")
+        prefix = jnp.sum(jnp.where(
+            jnp.arange(dp_size)[:, None] < my_dp, occur_all, 0), axis=0)
+        is_rr = strategy == STRATEGY_ROUND_ROBIN
+        sp = pick_members(tables.subs, cursors + jnp.where(is_rr, prefix, 0),
+                          sids, strategy, msg_hash)
+        total_occur = occur_all.sum(axis=0)
+        new_cursors = jnp.where(is_rr, cursors + total_occur, cursors)
+
+        overflow = mr.overflow | fr.overflow | slot_oflow
+        res = RouteResult(
+            matches=mr.matches, match_counts=mr.counts,
+            rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
+            shared_rows=sp.rows, shared_opts=sp.opts, overflow=overflow,
+            new_cursors=new_cursors, occur=total_occur)
+        # per-topic outputs gain a 'route' axis at dim 1; cursor state keeps
+        # its leading 'route' axis
+        return RouteResult(
+            matches=res.matches[:, None], match_counts=res.match_counts[:, None],
+            rows=res.rows[:, None], opts=res.opts[:, None],
+            fan_counts=res.fan_counts[:, None],
+            shared_rows=res.shared_rows[:, None],
+            shared_opts=res.shared_opts[:, None],
+            overflow=res.overflow[:, None],
+            new_cursors=res.new_cursors[None], occur=res.occur[None])
+
+    table_spec = P("route")
+    per_topic_spec = P("dp", "route")
+    out_specs = RouteResult(
+        matches=per_topic_spec, match_counts=per_topic_spec,
+        rows=per_topic_spec, opts=per_topic_spec, fan_counts=per_topic_spec,
+        shared_rows=per_topic_spec, shared_opts=per_topic_spec,
+        overflow=per_topic_spec, new_cursors=table_spec, occur=table_spec)
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(table_spec, table_spec, P("dp"), P("dp"), P("dp"), P("dp"),
+                  P()),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -7,9 +7,21 @@ exercised by bench.py only.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # env presets axon (real TPU); tests run CPU
+# persistent compile cache: repeat test runs skip XLA compilation
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already in the env, so the env var above is snapshotted
+# too late — override through the config API as well
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,153 @@
+"""Fan-out + shared-subscription selection + fused route step tests.
+
+Oracle: brute-force topic.match over the filter list, subscriber lists as
+python dicts, sequential round-robin for shared groups (the reference's
+per-group counter semantics, emqx_shared_sub.erl round_robin :284-290).
+"""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.models.router_engine import RouterTables, route_step
+from emqx_tpu.ops import intern as I
+from emqx_tpu.ops.fanout import build_subtable, fanout_normal, shared_slots
+from emqx_tpu.ops.match import encode_topics, match_batch
+from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN, pick_members
+from emqx_tpu.ops.trie import build_tables
+from emqx_tpu.utils import topic as T
+
+
+def build_fixture(filters, normal, filter_slots=None, shared_members=None,
+                  max_levels=8):
+    """filters: list[str]; normal: fid -> [(row, opts)]; returns full setup."""
+    intern = I.InternTable()
+    rows = np.zeros((len(filters), max_levels), np.int32)
+    lens = np.zeros(len(filters), np.int64)
+    for fid, f in enumerate(filters):
+        w = intern.encode_filter(T.words(f))
+        rows[fid, :len(w)] = w
+        lens[fid] = len(w)
+    trie = build_tables(rows, lens)
+    subs = build_subtable(len(filters), normal, filter_slots or {},
+                          shared_members or {})
+    return intern, RouterTables(trie=trie, subs=subs)
+
+
+def encode(intern, topics, max_levels=8):
+    tw = [T.words(t) for t in topics]
+    enc, lens, dollar, too_long = encode_topics(intern, tw, max_levels)
+    assert not too_long.any()
+    return enc, lens, dollar
+
+
+class TestFanout:
+    def test_basic_fanout(self):
+        filters = ["a/+", "a/#", "b"]
+        normal = {0: [(10, 1), (11, 2)], 1: [(12, 0)], 2: [(13, 1)]}
+        intern, tables = build_fixture(filters, normal)
+        enc, lens, dollar = encode(intern, ["a/x", "b", "zzz"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        fr = fanout_normal(tables.subs, mr.matches)
+        got0 = sorted(int(r) for r in fr.rows[0] if r >= 0)
+        assert got0 == [10, 11, 12]
+        assert int(fr.counts[0]) == 3
+        got1 = sorted(int(r) for r in fr.rows[1] if r >= 0)
+        assert got1 == [13]
+        assert int(fr.counts[2]) == 0
+        # opts travel with rows
+        opts0 = {int(r): int(o) for r, o in zip(fr.rows[0], fr.opts[0]) if r >= 0}
+        assert opts0 == {10: 1, 11: 2, 12: 0}
+
+    def test_fanout_overflow(self):
+        filters = ["t"]
+        normal = {0: [(i, 0) for i in range(40)]}
+        intern, tables = build_fixture(filters, normal)
+        enc, lens, dollar = encode(intern, ["t"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        fr = fanout_normal(tables.subs, mr.matches, fanout_cap=16)
+        assert bool(fr.overflow[0])
+        assert int(fr.counts[0]) == 40  # true count still reported
+
+    def test_empty_filter_no_subscribers(self):
+        filters = ["a", "b"]
+        normal = {0: [(1, 0)]}  # filter 1 has no subscribers
+        intern, tables = build_fixture(filters, normal)
+        enc, lens, dollar = encode(intern, ["b"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        fr = fanout_normal(tables.subs, mr.matches)
+        assert int(fr.counts[0]) == 0
+
+
+class TestSharedPick:
+    def setup_tables(self):
+        # filter 0 = "job/+" in group slot 0 (3 members), slot 1 (2 members)
+        filters = ["job/+"]
+        normal = {}
+        filter_slots = {0: [0, 1]}
+        shared_members = {0: [(100, 0), (101, 0), (102, 0)],
+                          1: [(200, 1), (201, 1)]}
+        return build_fixture(filters, normal, filter_slots, shared_members)
+
+    def test_round_robin_within_batch(self):
+        intern, tables = self.setup_tables()
+        enc, lens, dollar = encode(intern, ["job/1", "job/2", "job/3", "job/4"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        sids, oflow = shared_slots(tables.subs, mr.matches)
+        assert not bool(oflow.any())
+        cursors = np.zeros(2, np.int32)
+        sp = pick_members(tables.subs, cursors, sids,
+                          np.int32(STRATEGY_ROUND_ROBIN), np.zeros(4, np.int32))
+        # slot 0: members 100,101,102 → picks cycle in batch order
+        picks0 = [int(r) for r in sp.rows[:, 0]]
+        assert picks0 == [100, 101, 102, 100]
+        picks1 = [int(r) for r in sp.rows[:, 1]]
+        assert picks1 == [200, 201, 200, 201]
+        assert list(np.asarray(sp.new_cursors)) == [4, 4]
+
+    def test_round_robin_across_batches(self):
+        intern, tables = self.setup_tables()
+        enc, lens, dollar = encode(intern, ["job/1"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        sids, _ = shared_slots(tables.subs, mr.matches)
+        cursors = np.zeros(2, np.int32)
+        seen = []
+        for _ in range(4):
+            sp = pick_members(tables.subs, cursors, sids,
+                              np.int32(STRATEGY_ROUND_ROBIN),
+                              np.zeros(1, np.int32))
+            seen.append(int(sp.rows[0, 0]))
+            cursors = np.asarray(sp.new_cursors)
+        assert seen == [100, 101, 102, 100]
+
+    def test_hash_strategy_stable(self):
+        from emqx_tpu.ops.shared import STRATEGY_HASH_TOPIC
+        intern, tables = self.setup_tables()
+        enc, lens, dollar = encode(intern, ["job/1", "job/1"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        sids, _ = shared_slots(tables.subs, mr.matches)
+        h = np.array([77, 77], np.int32)  # same topic hash
+        sp = pick_members(tables.subs, np.zeros(2, np.int32), sids,
+                          np.int32(STRATEGY_HASH_TOPIC), h)
+        assert int(sp.rows[0, 0]) == int(sp.rows[1, 0])  # sticky per hash
+        assert list(np.asarray(sp.new_cursors)) == [0, 0]  # no advance
+
+
+class TestRouteStep:
+    def test_fused_step(self):
+        filters = ["s/+", "s/#", "q/job"]
+        normal = {0: [(1, 1)], 1: [(2, 2)]}
+        filter_slots = {2: [0]}
+        shared = {0: [(50, 1), (51, 1)]}
+        intern, tables = build_fixture(filters, normal, filter_slots, shared)
+        enc, lens, dollar = encode(intern, ["s/a", "q/job", "q/job"])
+        cursors = np.zeros(1, np.int32)
+        res = route_step(tables, cursors, enc, lens, dollar,
+                         np.zeros(3, np.int32), np.int32(STRATEGY_ROUND_ROBIN))
+        # topic 0: normal rows {1, 2}, no shared
+        assert sorted(int(r) for r in res.rows[0] if r >= 0) == [1, 2]
+        assert int(res.shared_rows[0].max()) == -1
+        # topics 1,2: shared picks round-robin over {50,51}
+        assert int(res.shared_rows[1, 0]) == 50
+        assert int(res.shared_rows[2, 0]) == 51
+        assert list(np.asarray(res.new_cursors)) == [2]
+        assert not bool(res.overflow.any())
