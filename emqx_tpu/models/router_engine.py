@@ -25,13 +25,20 @@ import numpy as np
 
 from emqx_tpu.ops.fanout import FanoutResult, SubTable, fanout_normal, shared_slots
 from emqx_tpu.ops.match import MatchResult, match_batch
+from emqx_tpu.ops.shapes import ShapeTables, shape_match
 from emqx_tpu.ops.shared import SharedPickResult, pick_members
 from emqx_tpu.ops.trie import TrieTables
 
 
 class RouterTables(NamedTuple):
-    """All device-resident routing state except shared-sub cursors."""
+    """Device routing state for the trie-NFA backend (general shapes)."""
     trie: TrieTables
+    subs: SubTable
+
+
+class ShapeRouterTables(NamedTuple):
+    """Device routing state for the shape-hash backend (the fast path)."""
+    shapes: ShapeTables
     subs: SubTable
 
 
@@ -48,6 +55,22 @@ class RouteResult(NamedTuple):
     occur: jax.Array          # [G] shared-slot occurrences this batch
 
 
+def post_match(subs: SubTable, mr: MatchResult, cursors: jax.Array,
+               msg_hash: jax.Array, strategy: jax.Array, *,
+               fanout_cap: int, slot_cap: int) -> RouteResult:
+    """Fan-out + shared-sub selection on a MatchResult (backend-agnostic)."""
+    fr: FanoutResult = fanout_normal(subs, mr.matches, fanout_cap=fanout_cap)
+    sids, slot_oflow = shared_slots(subs, mr.matches, slot_cap=slot_cap)
+    sp: SharedPickResult = pick_members(subs, cursors, sids, strategy,
+                                        msg_hash)
+    overflow = mr.overflow | fr.overflow | slot_oflow
+    return RouteResult(
+        matches=mr.matches, match_counts=mr.counts,
+        rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
+        shared_rows=sp.rows, shared_opts=sp.opts,
+        overflow=overflow, new_cursors=sp.new_cursors, occur=sp.occur)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("frontier_cap", "match_cap", "fanout_cap", "slot_cap"))
@@ -56,21 +79,23 @@ def route_step(tables: RouterTables, cursors: jax.Array, topics: jax.Array,
                strategy: jax.Array, *, frontier_cap: int = 16,
                match_cap: int = 64, fanout_cap: int = 128,
                slot_cap: int = 16) -> RouteResult:
-    """Route a micro-batch of publishes: match + fan-out + shared picks."""
-    mr: MatchResult = match_batch(
-        tables.trie, topics, lens, is_dollar,
-        frontier_cap=frontier_cap, match_cap=match_cap)
-    fr: FanoutResult = fanout_normal(tables.subs, mr.matches,
-                                     fanout_cap=fanout_cap)
-    sids, slot_oflow = shared_slots(tables.subs, mr.matches, slot_cap=slot_cap)
-    sp: SharedPickResult = pick_members(tables.subs, cursors, sids, strategy,
-                                        msg_hash)
-    overflow = mr.overflow | fr.overflow | slot_oflow
-    return RouteResult(
-        matches=mr.matches, match_counts=mr.counts,
-        rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
-        shared_rows=sp.rows, shared_opts=sp.opts,
-        overflow=overflow, new_cursors=sp.new_cursors, occur=sp.occur)
+    """Trie-NFA route step: match + fan-out + shared picks (general shapes)."""
+    mr = match_batch(tables.trie, topics, lens, is_dollar,
+                     frontier_cap=frontier_cap, match_cap=match_cap)
+    return post_match(tables.subs, mr, cursors, msg_hash, strategy,
+                      fanout_cap=fanout_cap, slot_cap=slot_cap)
+
+
+@functools.partial(jax.jit, static_argnames=("fanout_cap", "slot_cap"))
+def route_step_shapes(tables: ShapeRouterTables, cursors: jax.Array,
+                      topics: jax.Array, lens: jax.Array,
+                      is_dollar: jax.Array, msg_hash: jax.Array,
+                      strategy: jax.Array, *, fanout_cap: int = 128,
+                      slot_cap: int = 16) -> RouteResult:
+    """Shape-hash route step: one bucket gather per (topic, shape)."""
+    mr = shape_match(tables.shapes, topics, lens, is_dollar)
+    return post_match(tables.subs, mr, cursors, msg_hash, strategy,
+                      fanout_cap=fanout_cap, slot_cap=slot_cap)
 
 
 def empty_router_tables(filter_cap: int = 16) -> RouterTables:

@@ -1,0 +1,232 @@
+"""Shape-directed wildcard matching: the fast TPU path.
+
+Insight: a wildcard filter is its *shape* (which levels are '+', whether it
+ends in '#', how many concrete levels) plus the concrete words. Filters are
+grouped by shape into one bucketed hash table keyed by (shape, concrete-word
+path hash). Matching a topic then costs, per candidate shape, a dense VPU
+hash fold over the topic's levels plus ONE bucket row-gather — instead of the
+trie NFA's per-level frontier probes. On the reference's own bench shape
+(`device/{{id}}/+/{{num}}/#`, emqx_broker_bench.erl:25-34) there is exactly
+one shape, so matching is one gather per topic.
+
+This replaces the same reference hot path as ops/match.py (emqx_trie.erl
+do_match :208-266) with identical semantics (root-'$' exclusion, '#' matches
+zero levels); the trie NFA remains the fallback for filter sets with more
+distinct shapes than SHAPE_CAP. Match results are filter-id lists compatible
+with ops/fanout.py.
+
+Collision safety: 2x32-bit path hashes + shape-compatibility check; a false
+match needs a 64-bit collision within one shape (~2^-64 per pair).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.ops.intern import HASH, PLUS
+from emqx_tpu.ops.match import MatchResult
+
+BK = 16                 # filter entries per bucket (one row-gather wide)
+DEFAULT_SHAPE_CAP = 32  # max distinct shapes per table
+
+_U = np.uint32
+
+
+def _fold(h, w, l: int):
+    """One hash-fold step; identical under numpy and jax.numpy (uint32)."""
+    h = h ^ (w * _U(0x85EBCA77) + _U((l * 0x9E3779B1) & 0xFFFFFFFF))
+    h = h * _U(0xC2B2AE35)
+    return h ^ (h >> _U(15))
+
+
+def _fin(h):
+    h = h ^ (h >> _U(16))
+    h = h * _U(0x7FEB352D)
+    return h ^ (h >> _U(13))
+
+
+def _seed(shape_id, c1: int, c2: int):
+    return _fin(shape_id.astype("uint32") * _U(c1) + _U(c2))
+
+
+class ShapeTables(NamedTuple):
+    """Compiled shape-partitioned filter store (all int32; a JAX pytree).
+
+    shape_plus_mask: [NS] bit l set = level l is '+'.
+    shape_len: [NS] concrete level count (excluding trailing '#'); -1 = pad.
+    shape_has_hash: [NS] 1 if the shape ends in '#'.
+    shape_wild_root: [NS] 1 if level 0 is '+' or the shape is bare '#'
+      (excluded for '$'-rooted topics, emqx_topic.erl:66-69).
+    buckets: [NB, 3*BK] rows of h1[BK] | h2[BK] | fid[BK], fid -1 = empty.
+    """
+
+    shape_plus_mask: np.ndarray
+    shape_len: np.ndarray
+    shape_has_hash: np.ndarray
+    shape_wild_root: np.ndarray
+    buckets: np.ndarray
+    n_shapes: np.ndarray
+    n_filters: np.ndarray
+
+
+class ShapeCapacityError(ValueError):
+    """Filter set has more distinct shapes than the table capacity."""
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(2, (x - 1).bit_length())
+
+
+def _path_hashes(words: np.ndarray, slen, plus_mask, seeds1, seeds2):
+    """Fold concrete-word hashes over levels. words [N, L]; others [N]."""
+    h1, h2 = seeds1.copy(), seeds2.copy()
+    L = words.shape[1] if words.ndim == 2 else 0
+    for l in range(L):
+        concrete = (l < slen) & ((plus_mask >> l) & 1 == 0)
+        w = words[:, l].astype("uint32")
+        h1 = np.where(concrete, _fold(h1, w, 2 * l), h1)
+        h2 = np.where(concrete, _fold(h2, w, 2 * l + 1), h2)
+    return h1, h2
+
+
+def build_shape_tables(words: np.ndarray, lens: np.ndarray,
+                       filter_ids: Optional[np.ndarray] = None,
+                       shape_cap: int = DEFAULT_SHAPE_CAP,
+                       bucket_capacity: Optional[int] = None) -> ShapeTables:
+    """Compile a deduplicated filter set into ShapeTables (host, vectorized).
+
+    words: [F, L] interned level ids (PAD beyond lens); lens: [F] (>=1).
+    Raises ShapeCapacityError when distinct shapes exceed shape_cap (caller
+    falls back to the trie NFA backend).
+    """
+    words = np.asarray(words, np.int32)
+    lens = np.asarray(lens, np.int64)
+    F = len(lens)
+    if filter_ids is None:
+        filter_ids = np.arange(F)
+    filter_ids = np.asarray(filter_ids, np.int64)
+
+    if F == 0:
+        NSc = 1
+        return ShapeTables(
+            shape_plus_mask=np.zeros(NSc, np.int32),
+            shape_len=np.full(NSc, -1, np.int32),
+            shape_has_hash=np.zeros(NSc, np.int32),
+            shape_wild_root=np.zeros(NSc, np.int32),
+            buckets=np.concatenate([np.zeros((16, 2 * BK), np.int32),
+                                    np.full((16, BK), -1, np.int32)], axis=1),
+            n_shapes=np.int32(0), n_filters=np.int32(0))
+
+    L = words.shape[1]
+    if L > 20:
+        raise ValueError("shape tables support at most 20 levels")
+    arangeF = np.arange(F)
+    has_hash = (words[arangeF, lens - 1] == HASH).astype(np.int64)
+    slen = lens - has_hash
+    is_plus = (words == PLUS)
+    lvl = np.arange(L)[None, :]
+    plus_mask = ((is_plus & (lvl < slen[:, None])).astype(np.int64)
+                 << lvl).sum(axis=1)
+
+    sig = plus_mask | (slen << 24) | (has_hash << 60)
+    uniq, inv = np.unique(sig, return_inverse=True)
+    NS = len(uniq)
+    if NS > shape_cap:
+        raise ShapeCapacityError(f"{NS} shapes > cap {shape_cap}")
+    # pad the shape axis to the next pow2 of the ACTUAL count — every padded
+    # shape costs a full [B]-wide bucket gather per match call
+    NSc = 1 << max(0, (NS - 1).bit_length())
+
+    shape_plus_mask = np.zeros(NSc, np.int32)
+    shape_len = np.full(NSc, -1, np.int32)
+    shape_has_hash = np.zeros(NSc, np.int32)
+    shape_plus_mask[:NS] = (uniq & 0xFFFFFF).astype(np.int32)
+    shape_len[:NS] = ((uniq >> 24) & 0xFFFFFFFF).astype(np.int32)
+    shape_has_hash[:NS] = (uniq >> 60).astype(np.int32)
+    shape_wild_root = (((shape_plus_mask & 1) == 1)
+                       | ((shape_has_hash == 1) & (shape_len == 0))
+                       ).astype(np.int32)
+    shape_wild_root[shape_len < 0] = 0
+
+    sid = inv.astype(np.int64)
+    s1 = _seed(sid, 0x27D4EB2F, 0x165667B1)
+    s2 = _seed(sid, 0x85EBCA6B, 0xC2B2AE3D)
+    h1, h2 = _path_hashes(words, slen, plus_mask, s1, s2)
+
+    NB = bucket_capacity or _next_pow2(max(16, F // 6))
+    while True:
+        home = (_fin(h1 ^ (h2 * _U(0x9E3779B1))) & _U(NB - 1)).astype(np.int64)
+        order = np.argsort(home, kind="stable")
+        hs = home[order]
+        is_start = np.concatenate(([True], hs[1:] != hs[:-1]))
+        pos = np.arange(F)
+        run_start = np.maximum.accumulate(np.where(is_start, pos, 0))
+        rank = pos - run_start
+        if int(rank.max(initial=0)) < BK:
+            break
+        NB *= 2
+        if NB > 1 << 28:
+            raise MemoryError("shape bucket table too large")
+
+    buckets = np.zeros((NB, 3 * BK), np.int32)
+    buckets[:, 2 * BK:] = -1
+    buckets[hs, rank] = h1[order].astype(np.int32)
+    buckets[hs, BK + rank] = h2[order].astype(np.int32)
+    buckets[hs, 2 * BK + rank] = filter_ids[order].astype(np.int32)
+
+    return ShapeTables(
+        shape_plus_mask=shape_plus_mask, shape_len=shape_len,
+        shape_has_hash=shape_has_hash, shape_wild_root=shape_wild_root,
+        buckets=buckets, n_shapes=np.int32(NS), n_filters=np.int32(F))
+
+
+@jax.jit
+def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
+                is_dollar: jax.Array) -> MatchResult:
+    """Match a topic batch against all shapes: one bucket gather per shape.
+
+    Returns MatchResult with matches [B, NS] (each shape contributes at most
+    one filter id, -1 otherwise); counts [B]; overflow always False (the
+    output is exhaustive by construction).
+    """
+    B, L = topics.shape
+    NSc = st.shape_plus_mask.shape[0]
+    NB = st.buckets.shape[0]
+
+    sid = jax.lax.broadcasted_iota(jnp.int32, (1, NSc), 1)
+    h1 = jnp.broadcast_to(_seed(sid, 0x27D4EB2F, 0x165667B1), (B, NSc))
+    h2 = jnp.broadcast_to(_seed(sid, 0x85EBCA6B, 0xC2B2AE3D), (B, NSc))
+    slen = st.shape_len[None, :]
+    pmask = st.shape_plus_mask[None, :]
+    for l in range(L):
+        concrete = (l < slen) & ((pmask >> l) & 1 == 0)
+        w = topics[:, l:l + 1].astype(jnp.uint32)
+        h1 = jnp.where(concrete, _fold(h1, w, 2 * l), h1)
+        h2 = jnp.where(concrete, _fold(h2, w, 2 * l + 1), h2)
+
+    lens_ = lens[:, None]
+    compatible = jnp.where(st.shape_has_hash[None, :] == 1,
+                           lens_ >= slen, lens_ == slen)
+    compatible &= slen >= 0
+    compatible &= ~(is_dollar[:, None] & (st.shape_wild_root[None, :] == 1))
+    compatible &= lens_ > 0  # batch-padding rows match nothing
+
+    home = (_fin(h1 ^ (h2 * _U(0x9E3779B1)))
+            & _U(NB - 1)).astype(jnp.int32)
+    rows = st.buckets[home]  # [B, NSc, 3*BK] — the one gather
+    h1i = h1.astype(jnp.int32)[..., None]
+    h2i = h2.astype(jnp.int32)[..., None]
+    hit = ((rows[..., :BK] == h1i) & (rows[..., BK:2 * BK] == h2i)
+           & (rows[..., 2 * BK:] >= 0) & compatible[..., None])
+    idx = jnp.argmax(hit, axis=-1)
+    fid = jnp.take_along_axis(rows[..., 2 * BK:], idx[..., None],
+                              axis=-1)[..., 0]
+    matches = jnp.where(hit.any(-1), fid, -1)
+    counts = (matches >= 0).sum(axis=-1, dtype=jnp.int32)
+    return MatchResult(matches=matches, counts=counts,
+                       overflow=jnp.zeros(B, bool))

@@ -49,8 +49,12 @@ class SharedPickResult(NamedTuple):
 def _rank_over_runs(sids: jax.Array) -> jax.Array:
     """rank[b,k] = #occurrences of sids[b,k] earlier in flattened batch order.
 
-    -1 entries get rank 0 (unused). Stable sort keeps batch order within runs.
+    -1 entries get rank 0 (unused). Stable sort keeps batch order within
+    runs; run starts are recovered by scatter (XLA's native accumulate scans
+    are too slow on TPU — see ops.scan_ops).
     """
+    from emqx_tpu.ops.scan_ops import cumsum_blocked
+
     B, K = sids.shape
     flat = sids.reshape(-1)
     n = flat.shape[0]
@@ -59,8 +63,10 @@ def _rank_over_runs(sids: jax.Array) -> jax.Array:
     is_start = jnp.concatenate(
         [jnp.ones(1, bool), sorted_sids[1:] != sorted_sids[:-1]])
     pos = jnp.arange(n, dtype=jnp.int32)
-    start_pos = jnp.maximum.accumulate(jnp.where(is_start, pos, 0))
-    rank_sorted = pos - start_pos
+    run_id = cumsum_blocked(is_start.astype(jnp.int32)) - 1
+    starts = jnp.zeros(n, jnp.int32).at[
+        jnp.where(is_start, run_id, n)].set(pos, mode="drop")
+    rank_sorted = pos - starts[run_id]
     rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
     return rank.reshape(B, K)
 

@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from emqx_tpu.models.router_engine import RouterTables, RouteResult
 from emqx_tpu.ops.fanout import fanout_normal, shared_slots
 from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.shapes import shape_match
 from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN, pick_members
 
 
@@ -47,10 +48,14 @@ def put_sharded(mesh: Mesh, tables_stacked: RouterTables, cursors_stacked):
     return tables, cursors
 
 
-def make_sharded_route_step(mesh: Mesh, *, frontier_cap: int = 16,
+def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
+                            frontier_cap: int = 16,
                             match_cap: int = 64, fanout_cap: int = 128,
                             slot_cap: int = 16):
     """Build the jitted multi-device route step for `mesh` ('dp','route').
+
+    backend: 'trie' (RouterTables shards) or 'shapes' (ShapeRouterTables
+    shards — the fast path).
 
     Call signature of the returned fn:
       step(tables [R,...], cursors [R,G], topics [B,L], lens [B],
@@ -65,8 +70,11 @@ def make_sharded_route_step(mesh: Mesh, *, frontier_cap: int = 16,
         tables = jax.tree.map(lambda x: x[0], tables)  # this shard's slice
         cursors = cursors[0]
 
-        mr = match_batch(tables.trie, topics, lens, is_dollar,
-                         frontier_cap=frontier_cap, match_cap=match_cap)
+        if backend == "shapes":
+            mr = shape_match(tables.shapes, topics, lens, is_dollar)
+        else:
+            mr = match_batch(tables.trie, topics, lens, is_dollar,
+                             frontier_cap=frontier_cap, match_cap=match_cap)
         fr = fanout_normal(tables.subs, mr.matches, fanout_cap=fanout_cap)
         sids, slot_oflow = shared_slots(tables.subs, mr.matches,
                                         slot_cap=slot_cap)

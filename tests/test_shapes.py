@@ -1,0 +1,95 @@
+"""Shape-directed matcher equivalence tests (same oracle as the trie NFA)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.ops import intern as I
+from emqx_tpu.ops.match import encode_topics
+from emqx_tpu.ops.shapes import (ShapeCapacityError, build_shape_tables,
+                                 shape_match)
+from emqx_tpu.utils import topic as T
+from tests.test_trie_match import BASIC_FILTERS, WORDS, brute_force, rand_filter, rand_topic
+
+
+class ShapeFixture:
+    def __init__(self, filters, max_levels=8, shape_cap=32):
+        self.filters = filters
+        self.intern = I.InternTable()
+        self.max_levels = max_levels
+        rows = np.zeros((len(filters), max_levels), np.int32)
+        lens = np.zeros(len(filters), np.int64)
+        for fid, f in enumerate(filters):
+            wids = self.intern.encode_filter(T.words(f))
+            rows[fid, :len(wids)] = wids
+            lens[fid] = len(wids)
+        self.tables = build_shape_tables(rows, lens, shape_cap=shape_cap)
+
+    def match(self, topics):
+        tw = [T.words(t) for t in topics]
+        enc, lens, dollar, too_long = encode_topics(self.intern, tw,
+                                                    self.max_levels)
+        assert not too_long.any()
+        res = shape_match(self.tables, enc, lens, dollar)
+        return [sorted(int(x) for x in res.matches[i] if x >= 0)
+                for i in range(len(topics))]
+
+
+class TestShapeMatch:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return ShapeFixture(BASIC_FILTERS)
+
+    @pytest.mark.parametrize("topic", [
+        "a/b/c", "a", "a/b", "x", "/a", "/x", "$sys", "$sys/a", "$sys/a/b",
+        "a/x/c", "a/b/c/d", "", "x/y/z", "x/a", "unseen/words",
+    ])
+    def test_matches_brute_force(self, fx, topic):
+        assert fx.match([topic])[0] == brute_force(topic, BASIC_FILTERS), topic
+
+    def test_batch_padding_rows(self, fx):
+        enc = np.zeros((3, fx.max_levels), np.int32)
+        res = shape_match(fx.tables, enc, np.zeros(3, np.int32),
+                          np.zeros(3, bool))
+        assert int(res.counts.sum()) == 0
+
+    def test_empty(self):
+        fx = ShapeFixture([])
+        assert fx.match(["a/b"]) == [[]]
+
+    def test_hash_zero_levels(self):
+        fx = ShapeFixture(["sport/#", "#"])
+        assert fx.match(["sport"])[0] == [0, 1]
+        assert fx.match(["sport/x"])[0] == [0, 1]
+        assert fx.match(["other"])[0] == [1]
+
+    def test_shape_cap_raises(self):
+        # 5 distinct shapes with cap 4
+        filters = ["a", "a/b", "a/b/c", "a/+", "+/a/#"]
+        with pytest.raises(ShapeCapacityError):
+            ShapeFixture(filters, shape_cap=4)
+
+    def test_bench_shape_is_one_shape(self):
+        filters = [f"device/{i}/+/{n}/#" for i in range(8) for n in range(16)]
+        fx = ShapeFixture(filters)
+        assert int(fx.tables.n_shapes) == 1
+        topics = [f"device/{i}/x/{n}/tail" for i in range(8) for n in range(16)]
+        assert fx.match(topics) == [brute_force(t, filters) for t in topics]
+
+    @pytest.mark.parametrize("seed", [3, 11, 42, 777])
+    def test_randomized_equivalence(self, seed):
+        rng = random.Random(seed)
+        filters = sorted({rand_filter(rng) for _ in range(rng.randint(5, 120))})
+        try:
+            fx = ShapeFixture(filters, shape_cap=256)
+        except ShapeCapacityError:
+            pytest.skip("too many shapes")
+        topics = [rand_topic(rng) for _ in range(64)]
+        assert fx.match(topics) == [brute_force(t, filters) for t in topics]
+
+    def test_deep_and_empty_levels(self):
+        filters = ["a//b", "//", "+//#", "a/+//+/a"]
+        fx = ShapeFixture(filters)
+        topics = ["a//b", "//", "///", "a/x//y/a", "a////a", "//x"]
+        assert fx.match(topics) == [brute_force(t, filters) for t in topics]
