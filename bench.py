@@ -135,14 +135,19 @@ def main():
     log(f"sanity: matches={mc}/{B}, fan={fc}, shared={sc}, overflow={ov}")
     assert mc == B, "every bench topic must match exactly one filter"
 
-    # sync round-trip latency (a single batch, blocked)
+    # sync round-trip latency distribution (single blocked batches) — the
+    # BASELINE.md p99 <2ms criterion is judged on this per-batch latency
     sync = []
-    for k in range(3):
+    for k in range(30):
         t0 = time.time()
         r = step(staged[k % 8], cursors0)
-        _ = np.asarray(r.counts if hasattr(r, 'counts') else r.match_counts)
+        _ = np.asarray(r.match_counts)
         sync.append(time.time() - t0)
-    log(f"sync round-trip: {min(sync) * 1000:.1f}ms/batch")
+    sync.sort()
+    p50_ms = sync[len(sync) // 2] * 1000
+    p99_ms = sync[min(len(sync) - 1, int(len(sync) * 0.99))] * 1000
+    log(f"sync round-trip: p50 {p50_ms:.1f}ms p99 {p99_ms:.1f}ms/batch "
+        f"(includes relay HTTP dispatch overhead)")
 
     # pipelined window closed by one scalar readback — sustained device
     # throughput. A digest reduction over every output array forces the full
@@ -185,7 +190,8 @@ def main():
         "unit": "topic-matches/s",
         "vs_baseline": round(matches_per_sec / target, 2),
         "per_batch_ms": round(per_batch * 1000, 2),
-        "sync_rt_ms": round(min(sync) * 1000, 1),
+        "sync_p50_ms": round(p50_ms, 1),
+        "sync_p99_ms": round(p99_ms, 1),
         "batch": B,
         "subs": subs,
     }))

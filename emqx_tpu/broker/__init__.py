@@ -1,0 +1,7 @@
+"""Host broker runtime: the non-device half of the framework.
+
+Mirrors the reference's core app layers (SURVEY.md §1 layers 0-7):
+listeners → connections → channel FSM → session → pubsub engine, with the
+wildcard match + fan-out hot path delegated to the device router
+(emqx_tpu.models.router_engine) in micro-batches.
+"""

@@ -1,0 +1,695 @@
+"""MQTT protocol state machine, transport-agnostic.
+
+Parity: emqx_channel.erl — CONNECT pipeline (check → enrich → authenticate →
+open session, :285-533), PUBLISH pipeline (quota → alias → authz → caps,
+:539-628), SUBSCRIBE with per-filter authz (:427-460,660-691), QoS0/1/2
+semantics, will message, keepalive accounting, takeover pendings (:746-790),
+and MQTT5 extras (topic alias, assigned clientid, session expiry).
+
+The channel is owned by one connection task; `handle_in(pkt)` returns and
+the channel pushes outbound packets through the `send` callback. Broker
+deliveries arrive via `deliver()` from the same event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+from emqx_tpu.broker.message import Message, make, now_ms
+from emqx_tpu.broker.mqueue import MQueueOpts
+from emqx_tpu.broker.session import Session, SessionConf, SessionError
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.utils import topic as T
+
+class ParkedSubscriber:
+    """Deliver target for a detached persistent session: enqueue only
+    (the reference's disconnected-state channel, emqx_channel handle_deliver
+    while conn_state=disconnected)."""
+
+    def __init__(self, session, node):
+        self.session = session
+        self.node = node
+
+    def deliver(self, topic_filter: str, msg) -> bool:
+        if msg.is_expired():
+            self.node.metrics.inc("delivery.dropped")
+            self.node.metrics.inc("delivery.dropped.expired")
+            return True
+        self.session.enqueue([(msg, msg.headers.get("subopts", {}))])
+        return True
+
+
+CONN_IDLE = "idle"
+CONN_CONNECTING = "connecting"
+CONN_CONNECTED = "connected"
+CONN_TAKING_OVER = "taking_over"
+CONN_DISCONNECTED = "disconnected"
+
+_ASSIGNED_SEQ = iter(range(1, 1 << 62))
+
+
+class ProtocolError(Exception):
+    def __init__(self, rc: int, detail: str = ""):
+        self.rc = rc
+        super().__init__(f"protocol error rc=0x{rc:02x} {detail}")
+
+
+def session_conf_from(mqtt: dict, expiry_interval: int) -> SessionConf:
+    return SessionConf(
+        max_subscriptions=mqtt.get("max_subscriptions", 0),
+        upgrade_qos=mqtt.get("upgrade_qos", False),
+        retry_interval=mqtt.get("retry_interval", 30),
+        max_awaiting_rel=mqtt.get("max_awaiting_rel", 100),
+        await_rel_timeout=mqtt.get("await_rel_timeout", 300),
+        session_expiry_interval=expiry_interval,
+        max_inflight=mqtt.get("max_inflight", 32),
+        mqueue=MQueueOpts(
+            max_len=mqtt.get("max_mqueue_len", 1000),
+            store_qos0=mqtt.get("mqueue_store_qos0", True),
+            priorities=mqtt.get("mqueue_priorities", {}),
+            default_priority=mqtt.get("mqueue_default_priority", "lowest")))
+
+
+class Channel:
+    def __init__(self, node, conninfo: dict,
+                 send: Callable[[list[P.Packet]], None],
+                 close: Callable[[str], None]):
+        self.node = node
+        self.conninfo = conninfo        # peername, sockname, ws?, zone
+        self.send = send
+        self.close = close
+        self.conn_state = CONN_IDLE
+        self.zone = conninfo.get("zone")
+        self.mqtt = node.config.mqtt(self.zone)
+
+        self.proto_ver = C.MQTT_V4
+        self.clientinfo: dict = {}
+        self.clientid: str = ""
+        self.session: Optional[Session] = None
+        self.sid: Optional[int] = None  # broker subscriber id
+        self.keepalive: int = 0
+        self.will_msg: Optional[Message] = None
+        self.alias_in: dict[int, str] = {}   # v5 inbound topic aliases
+        self.connected_at: int = 0
+        self.disconnect_reason: Optional[str] = None
+        self._pendings: list[Message] = []   # deliveries during takeover
+        self.mountpoint: Optional[str] = None
+
+    # ================= inbound dispatch =================
+    async def handle_in(self, pkt: P.Packet) -> None:
+        m = self.node.metrics
+        name = type(pkt).__name__.lower()
+        if isinstance(pkt, P.Connect):
+            m.inc_recv("connect")
+            await self._handle_connect(pkt)
+        elif self.conn_state != CONN_CONNECTED:
+            raise ProtocolError(C.RC_PROTOCOL_ERROR,
+                                f"{name} before CONNECT")
+        elif isinstance(pkt, P.Publish):
+            m.inc_recv("publish")
+            self._handle_publish(pkt)
+        elif isinstance(pkt, P.Puback):
+            m.inc_recv("puback")
+            self._handle_puback(pkt)
+        elif isinstance(pkt, P.Pubrec):
+            m.inc_recv("pubrec")
+            self._handle_pubrec(pkt)
+        elif isinstance(pkt, P.Pubrel):
+            m.inc_recv("pubrel")
+            self._handle_pubrel(pkt)
+        elif isinstance(pkt, P.Pubcomp):
+            m.inc_recv("pubcomp")
+            self._handle_pubcomp(pkt)
+        elif isinstance(pkt, P.Subscribe):
+            m.inc_recv("subscribe")
+            self._handle_subscribe(pkt)
+        elif isinstance(pkt, P.Unsubscribe):
+            m.inc_recv("unsubscribe")
+            self._handle_unsubscribe(pkt)
+        elif isinstance(pkt, P.Pingreq):
+            m.inc_recv("pingreq")
+            self._send([P.Pingresp()])
+        elif isinstance(pkt, P.Disconnect):
+            m.inc_recv("disconnect")
+            self._handle_disconnect(pkt)
+        elif isinstance(pkt, P.Auth):
+            m.inc_recv("auth")
+            self._send([P.Disconnect(reason_code=C.RC_IMPLEMENTATION_SPECIFIC_ERROR)])
+        else:
+            raise ProtocolError(C.RC_PROTOCOL_ERROR, f"unexpected {name}")
+
+    def _send(self, pkts: list[P.Packet]) -> None:
+        for p in pkts:
+            self.node.metrics.inc_sent(type(p).__name__.lower())
+        self.send(pkts)
+
+    # ================= CONNECT =================
+    async def _handle_connect(self, pkt: P.Connect) -> None:
+        if self.conn_state != CONN_IDLE:
+            raise ProtocolError(C.RC_PROTOCOL_ERROR, "duplicate CONNECT")
+        self.conn_state = CONN_CONNECTING
+        self.proto_ver = pkt.proto_ver
+        self.node.metrics.inc("client.connect")
+        self.node.hooks.run("client.connect", (self._conninfo_map(pkt),))
+
+        # --- check: protocol version / clientid (emqx_channel check_connect)
+        if pkt.proto_ver not in (C.MQTT_V3, C.MQTT_V4, C.MQTT_V5):
+            return self._connack_error(C.RC_UNSUPPORTED_PROTOCOL_VERSION)
+        clientid = pkt.clientid
+        if not clientid:
+            if pkt.proto_ver < C.MQTT_V5 and not pkt.clean_start:
+                return self._connack_error(C.RC_CLIENT_IDENTIFIER_NOT_VALID)
+            clientid = f"emqx_tpu_{next(_ASSIGNED_SEQ)}_{now_ms()}"
+            self._assigned_clientid = clientid
+        else:
+            self._assigned_clientid = None
+        if len(clientid) > self.mqtt.get("max_clientid_len", 65535):
+            return self._connack_error(C.RC_CLIENT_IDENTIFIER_NOT_VALID)
+
+        props = pkt.properties or {}
+        if pkt.proto_ver == C.MQTT_V5:
+            expiry = props.get("session_expiry_interval", 0)
+        else:
+            expiry = (self.mqtt.get("session_expiry_interval", 7200)
+                      if not pkt.clean_start else 0)
+
+        if self.mqtt.get("use_username_as_clientid") and pkt.username:
+            clientid = pkt.username
+        self.clientid = clientid
+        self.clientinfo = {
+            "clientid": clientid, "username": pkt.username,
+            "peername": self.conninfo.get("peername"),
+            "sockname": self.conninfo.get("sockname"),
+            "proto_ver": pkt.proto_ver, "proto_name": pkt.proto_name,
+            "clean_start": pkt.clean_start, "keepalive": pkt.keepalive,
+            "zone": self.zone, "mountpoint": None,
+            "is_bridge": getattr(pkt, "is_bridge", False),
+            "connected_at": now_ms(),
+            "conn_props": props,
+        }
+
+        # --- banned check (emqx_banned:check in emqx_channel:authenticate)
+        banned = getattr(self.node, "banned", None)
+        if banned is not None and banned.check(self.clientinfo):
+            return self._connack_error(C.RC_BANNED)
+
+        # --- authenticate (hooks chain; default allow)
+        self.node.metrics.inc("client.authenticate")
+        auth_result = self.node.hooks.run_fold(
+            "client.authenticate", (self.clientinfo,),
+            {"ok": True, "password": pkt.password})
+        if not (isinstance(auth_result, dict) and auth_result.get("ok")):
+            self.node.metrics.inc("packets.connack.auth_error")
+            rc = (auth_result or {}).get("rc", C.RC_NOT_AUTHORIZED) \
+                if isinstance(auth_result, dict) else C.RC_NOT_AUTHORIZED
+            return self._connack_error(rc)
+        if isinstance(auth_result, dict):
+            self.clientinfo.update(
+                {k: v for k, v in auth_result.items()
+                 if k in ("is_superuser", "mountpoint", "username")})
+        self.mountpoint = self.clientinfo.get("mountpoint")
+        if self.mountpoint:
+            self.mountpoint = T.feed_var(
+                "%c", self.clientid,
+                T.feed_var("%u", self.clientinfo.get("username") or "",
+                           self.mountpoint))
+            self.clientinfo["mountpoint"] = self.mountpoint
+
+        # --- will message
+        if pkt.will is not None:
+            self.will_msg = make(
+                clientid, pkt.will.qos, self._mount(pkt.will.topic),
+                pkt.will.payload, flags={"retain": pkt.will.retain},
+                headers={"username": pkt.username,
+                         "properties": pkt.will.properties or {}})
+
+        # --- keepalive (server may override, v5 server_keep_alive)
+        server_ka = self.mqtt.get("server_keepalive", 0)
+        self.keepalive = server_ka or pkt.keepalive
+
+        # --- open session (clean-start discard / takeover)
+        conf = session_conf_from(self.mqtt, expiry)
+        session, present = await self.node.cm.open_session(
+            pkt.clean_start, clientid, conf, self)
+        self.session = session
+        if present:
+            self.node.metrics.inc("session.resumed")
+            self.node.hooks.run("session.resumed",
+                                (self.clientinfo, session))
+        else:
+            self.node.metrics.inc("session.created")
+            self.node.hooks.run("session.created",
+                                (self.clientinfo, session))
+
+        # --- register + connack
+        self.node.cm.register_channel(clientid, self, self.info())
+        parked_sid = getattr(session, "parked_sid", None)
+        if parked_sid is not None:
+            # re-attach to the parked session's live broker subscriptions
+            self.sid = parked_sid
+            session.parked_sid = None
+            self.node.broker.swap_subscriber(self.sid, self)
+        else:
+            self.sid = self.node.broker.register(self, clientid)
+            # resumed (takenover) sessions re-install routes under new sid
+            for f, opts in list(session.subscriptions.items()):
+                self.node.broker.subscribe(
+                    self.sid, f,
+                    {k: v for k, v in opts.items() if k != "share"})
+        self.conn_state = CONN_CONNECTED
+        self.connected_at = now_ms()
+        self.node.metrics.inc("client.connected")
+        self.node.hooks.run("client.connected", (self.clientinfo, self.info()))
+
+        ack_props = None
+        if pkt.proto_ver == C.MQTT_V5:
+            ack_props = {
+                "session_expiry_interval": expiry,
+                "receive_maximum": conf.max_inflight,
+                "maximum_qos": self.mqtt.get("max_qos_allowed", 2),
+                "retain_available": int(self.mqtt.get("retain_available", True)),
+                "maximum_packet_size": self.mqtt.get("max_packet_size"),
+                "topic_alias_maximum": self.mqtt.get("max_topic_alias", 65535),
+                "wildcard_subscription_available":
+                    int(self.mqtt.get("wildcard_subscription", True)),
+                "subscription_identifier_available": 1,
+                "shared_subscription_available":
+                    int(self.mqtt.get("shared_subscription", True)),
+            }
+            if server_ka:
+                ack_props["server_keep_alive"] = server_ka
+            if self._assigned_clientid:
+                ack_props["assigned_client_identifier"] = clientid
+        self.node.metrics.inc("client.connack")
+        self.node.hooks.run("client.connack",
+                            (self.clientinfo, C.RC_SUCCESS))
+        self._send([P.Connack(session_present=present,
+                              reason_code=C.RC_SUCCESS,
+                              properties=ack_props)])
+        # replay resumed session state
+        if present:
+            self._send_replay(session.replay())
+
+    def _connack_error(self, rc: int) -> None:
+        self.node.metrics.inc("packets.connack.error")
+        self.node.hooks.run("client.connack", (self.clientinfo, rc))
+        code = rc if self.proto_ver == C.MQTT_V5 else C.rc_to_connack_v3(rc)
+        self._send([P.Connack(session_present=False, reason_code=code)])
+        self.close(f"connack_error_0x{rc:02x}")
+
+    # ================= PUBLISH =================
+    def _mount(self, topic: str) -> str:
+        return T.prepend(self.mountpoint, topic)
+
+    def _unmount(self, topic: str) -> str:
+        if self.mountpoint and topic.startswith(self.mountpoint):
+            return topic[len(self.mountpoint):]
+        return topic
+
+    def _handle_publish(self, pkt: P.Publish) -> None:
+        topic = pkt.topic
+        # v5 topic alias resolution (emqx_channel packet_to_message)
+        props = pkt.properties or {}
+        alias = props.get("topic_alias")
+        if self.proto_ver == C.MQTT_V5 and alias is not None:
+            if not (0 < alias <= self.mqtt.get("max_topic_alias", 65535)):
+                return self._disconnect_now(C.RC_TOPIC_ALIAS_INVALID)
+            if topic:
+                self.alias_in[alias] = topic
+            else:
+                topic = self.alias_in.get(alias)
+                if topic is None:
+                    return self._disconnect_now(C.RC_PROTOCOL_ERROR,
+                                                "unknown topic alias")
+        if not topic or not T.validate(topic, "name"):
+            return self._puberr(pkt, C.RC_TOPIC_NAME_INVALID)
+        if pkt.qos > self.mqtt.get("max_qos_allowed", 2):
+            return self._puberr(pkt, C.RC_QOS_NOT_SUPPORTED)
+        if pkt.retain and not self.mqtt.get("retain_available", True):
+            return self._puberr(pkt, C.RC_RETAIN_NOT_SUPPORTED)
+
+        # authz (emqx_channel check_pub_authz)
+        if not self._authorize("publish", topic):
+            self.node.metrics.inc("packets.publish.auth_error")
+            return self._puberr(pkt, C.RC_NOT_AUTHORIZED)
+
+        msg = make(self.clientid, pkt.qos, self._mount(topic), pkt.payload,
+                   flags={"retain": pkt.retain, "dup": pkt.dup},
+                   headers={"username": self.clientinfo.get("username"),
+                            "peername": self.conninfo.get("peername"),
+                            "properties": props,
+                            "proto_ver": self.proto_ver})
+        self.node.metrics.inc_msg_recv(pkt.qos)
+
+        if pkt.qos == C.QOS_0:
+            self.node.broker.publish(msg)
+        elif pkt.qos == C.QOS_1:
+            n = self.node.broker.publish(msg)
+            rc = C.RC_SUCCESS if n else C.RC_NO_MATCHING_SUBSCRIBERS
+            if self.proto_ver < C.MQTT_V5:
+                rc = C.RC_SUCCESS
+            self._send([P.Puback(packet_id=pkt.packet_id, reason_code=rc)])
+        else:  # QoS2: ack first, publish on PUBREL (emqx_channel do_publish)
+            try:
+                self.session.publish_qos2(pkt.packet_id)
+                self.session.extra_qos2 = getattr(self.session, "extra_qos2", {})
+                self.session.extra_qos2[pkt.packet_id] = msg
+                self._send([P.Pubrec(packet_id=pkt.packet_id,
+                                     reason_code=C.RC_SUCCESS)])
+            except SessionError as e:
+                self.node.metrics.inc("packets.publish.dropped")
+                self._send([P.Pubrec(packet_id=pkt.packet_id,
+                                     reason_code=e.rc)])
+
+    def _puberr(self, pkt: P.Publish, rc: int) -> None:
+        self.node.metrics.inc("packets.publish.error")
+        if pkt.qos == C.QOS_0:
+            if self.proto_ver == C.MQTT_V5 and rc in (
+                    C.RC_TOPIC_NAME_INVALID,):
+                self._disconnect_now(rc)
+            return
+        cls = P.Puback if pkt.qos == C.QOS_1 else P.Pubrec
+        code = rc if self.proto_ver == C.MQTT_V5 else C.RC_SUCCESS
+        if self.proto_ver < C.MQTT_V5 and rc == C.RC_NOT_AUTHORIZED:
+            # v3: no way to signal; drop silently (emqx behavior)
+            return
+        self._send([cls(packet_id=pkt.packet_id, reason_code=code)])
+
+    def _authorize(self, action: str, topic: str) -> bool:
+        if self.clientinfo.get("is_superuser"):
+            return True
+        self.node.metrics.inc("client.authorize")
+        res = self.node.hooks.run_fold(
+            "client.authorize", (self.clientinfo, action, topic), "allow")
+        allowed = res != "deny"
+        self.node.metrics.inc(
+            "authorization.allow" if allowed else "authorization.deny")
+        return allowed
+
+    # ================= acks =================
+    def _handle_puback(self, pkt: P.Puback) -> None:
+        try:
+            msg = self.session.puback(pkt.packet_id)
+            self.node.metrics.inc("messages.acked")
+            self.node.hooks.run("message.acked", (self.clientinfo, msg))
+            self._send_dequeued(self.session.dequeue())
+        except SessionError:
+            self.node.metrics.inc("packets.puback.missed")
+
+    def _handle_pubrec(self, pkt: P.Pubrec) -> None:
+        try:
+            if pkt.reason_code >= 0x80:
+                self.session.inflight.delete(pkt.packet_id)
+                return
+            self.session.pubrec(pkt.packet_id)
+            self._send([P.Pubrel(packet_id=pkt.packet_id)])
+        except SessionError as e:
+            self.node.metrics.inc("packets.pubrec.missed")
+            if e.rc == C.RC_PACKET_IDENTIFIER_NOT_FOUND:
+                self._send([P.Pubrel(packet_id=pkt.packet_id,
+                                     reason_code=C.RC_PACKET_IDENTIFIER_NOT_FOUND)])
+
+    def _handle_pubrel(self, pkt: P.Pubrel) -> None:
+        try:
+            self.session.pubrel(pkt.packet_id)
+            msg = getattr(self.session, "extra_qos2", {}).pop(
+                pkt.packet_id, None)
+            if msg is not None:
+                self.node.broker.publish(msg)
+            self._send([P.Pubcomp(packet_id=pkt.packet_id)])
+        except SessionError:
+            self.node.metrics.inc("packets.pubrel.missed")
+            self._send([P.Pubcomp(packet_id=pkt.packet_id,
+                                  reason_code=C.RC_PACKET_IDENTIFIER_NOT_FOUND)])
+
+    def _handle_pubcomp(self, pkt: P.Pubcomp) -> None:
+        try:
+            msg = self.session.pubcomp(pkt.packet_id)
+            self.node.metrics.inc("messages.acked")
+            self.node.hooks.run("message.acked", (self.clientinfo, msg))
+            self._send_dequeued(self.session.dequeue())
+        except SessionError:
+            self.node.metrics.inc("packets.pubcomp.missed")
+
+    # ================= SUBSCRIBE / UNSUBSCRIBE =================
+    def _handle_subscribe(self, pkt: P.Subscribe) -> None:
+        import dataclasses
+        raw = [(tf, dataclasses.asdict(o) if dataclasses.is_dataclass(o)
+                else dict(o)) for tf, o in pkt.filters]
+        filters = self.node.hooks.run_fold(
+            "client.subscribe", (self.clientinfo, pkt.properties or {}), raw)
+        self.node.metrics.inc("client.subscribe")
+        codes = []
+        sub_props = pkt.properties or {}
+        subid = sub_props.get("subscription_identifier")
+        for tf, opts in filters:
+            code = self._do_subscribe(tf, dict(opts), subid)
+            codes.append(code)
+        self._send([P.Suback(packet_id=pkt.packet_id, reason_codes=codes)])
+
+    def _do_subscribe(self, tf: str, opts: dict, subid) -> int:
+        try:
+            real, popts = T.parse(tf, opts)
+        except T.TopicError:
+            return C.RC_TOPIC_FILTER_INVALID
+        if not T.validate(real, "filter"):
+            return C.RC_TOPIC_FILTER_INVALID
+        if T.levels(real) > self.mqtt.get("max_topic_levels", 128):
+            return C.RC_TOPIC_FILTER_INVALID
+        if T.wildcard(real) and not self.mqtt.get("wildcard_subscription", True):
+            return C.RC_WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+        if popts.get("share"):
+            if not self.mqtt.get("shared_subscription", True):
+                return C.RC_SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+            if popts.get("nl"):
+                return C.RC_PROTOCOL_ERROR  # v5: no-local on shared is error
+        if not self._authorize("subscribe", real):
+            self.node.metrics.inc("packets.subscribe.auth_error")
+            return C.RC_NOT_AUTHORIZED
+        qos = min(int(popts.get("qos", 0)),
+                  self.mqtt.get("max_qos_allowed", 2))
+        popts["qos"] = qos
+        if subid is not None:
+            popts["subid"] = subid
+        # mountpoint applies to the real filter, share prefix kept outside
+        mounted_real = self._mount(real)
+        group = popts.get("share")
+        full = f"$share/{group}/{mounted_real}" if group else mounted_real
+        try:
+            self.session.subscribe(full, popts)
+        except SessionError as e:
+            return e.rc
+        self.node.broker.subscribe(self.sid, full,
+                                   {k: v for k, v in popts.items()
+                                    if k != "share"})
+        self.node.hooks.run("session.subscribed",
+                            (self.clientinfo, mounted_real, popts))
+        return qos  # granted QoS doubles as v5 success code 0..2
+
+    def _handle_unsubscribe(self, pkt: P.Unsubscribe) -> None:
+        self.node.metrics.inc("client.unsubscribe")
+        filters = self.node.hooks.run_fold(
+            "client.unsubscribe", (self.clientinfo, pkt.properties or {}),
+            list(pkt.filters))
+        codes = []
+        for tf in filters:
+            try:
+                real, popts = T.parse(tf)
+            except T.TopicError:
+                codes.append(C.RC_TOPIC_FILTER_INVALID)
+                continue
+            mounted_real = self._mount(real)
+            group = popts.get("share")
+            full = (f"$share/{group}/{mounted_real}" if group
+                    else mounted_real)
+            self.node.broker.unsubscribe(self.sid, full)
+            try:
+                self.session.unsubscribe(full)
+                self.node.hooks.run("session.unsubscribed",
+                                    (self.clientinfo, mounted_real))
+                codes.append(C.RC_SUCCESS)
+            except SessionError:
+                codes.append(C.RC_NO_SUBSCRIPTION_EXISTED)
+        self._send([P.Unsuback(packet_id=pkt.packet_id, reason_codes=codes)])
+
+    # ================= DISCONNECT =================
+    def _handle_disconnect(self, pkt: P.Disconnect) -> None:
+        props = pkt.properties or {}
+        if self.proto_ver == C.MQTT_V5 and self.session is not None:
+            new_exp = props.get("session_expiry_interval")
+            if new_exp is not None:
+                if (self.session.conf.session_expiry_interval == 0
+                        and new_exp > 0):
+                    return self._disconnect_now(C.RC_PROTOCOL_ERROR)
+                self.session.conf.session_expiry_interval = new_exp
+        if pkt.reason_code == C.RC_SUCCESS:
+            self.will_msg = None        # normal disconnect drops the will
+        self.disconnect_reason = "normal"
+        self.close("disconnect")
+
+    def _disconnect_now(self, rc: int, detail: str = "") -> None:
+        if self.proto_ver == C.MQTT_V5:
+            self._send([P.Disconnect(reason_code=rc)])
+        self.disconnect_reason = f"protocol_0x{rc:02x}"
+        self.close(detail or f"disconnect_0x{rc:02x}")
+
+    # ================= delivery (broker → client) =================
+    def deliver(self, topic_filter: str, msg: Message) -> bool:
+        """Subscriber callback (the `{deliver,...}` message analog)."""
+        if self.conn_state == CONN_TAKING_OVER:
+            self._pendings.append(msg)
+            return True
+        if self.session is None:
+            return False
+        subopts = msg.headers.get("subopts", {})
+        if (self.mqtt.get("ignore_loop_deliver")
+                and msg.from_ == self.clientid):
+            self.node.metrics.inc("delivery.dropped")
+            self.node.metrics.inc("delivery.dropped.no_local")
+            return True
+        if msg.is_expired():
+            self.node.metrics.inc("delivery.dropped")
+            self.node.metrics.inc("delivery.dropped.expired")
+            return True
+        if self.conn_state != CONN_CONNECTED:
+            self.session.enqueue([(msg, subopts)])
+            return True
+        out = self.session.deliver([(msg, subopts)])
+        self._send_deliveries(out)
+        return True
+
+    def _send_deliveries(self, out: list) -> None:
+        pkts = []
+        for pid, m in out:
+            m.update_expiry()
+            pkts.append(self._to_publish(pid, m))
+            self.node.metrics.inc_msg_sent(m.qos)
+        if pkts:
+            self._send(pkts)
+
+    def _to_publish(self, pid: Optional[int], m: Message) -> P.Publish:
+        props = dict(m.headers.get("properties") or {}) \
+            if self.proto_ver == C.MQTT_V5 else None
+        return P.Publish(topic=self._unmount(m.topic), payload=m.payload,
+                         qos=m.qos, retain=m.retain, dup=m.dup,
+                         packet_id=pid or 0, properties=props)
+
+    def _send_dequeued(self, items: list[tuple[int, Message]]) -> None:
+        """Send mqueue refill: pid 0 entries are QoS0 (no ack expected)."""
+        self._send_deliveries([(pid or None, m) for pid, m in items])
+
+    def _send_replay(self, items: list) -> None:
+        pkts = []
+        for pid, phase, msg in items:
+            if phase == "publish":
+                pkts.append(self._to_publish(pid, msg))
+                self.node.metrics.inc_msg_sent(msg.qos)
+            else:
+                pkts.append(P.Pubrel(packet_id=pid))
+        if pkts:
+            self._send(pkts)
+
+    # ================= timers =================
+    def retry_deliveries(self) -> None:
+        if self.session and self.conn_state == CONN_CONNECTED:
+            items = self.session.retry()
+            for _pid, phase, m in items:
+                if phase == "publish":
+                    m.set_flag("dup", True)
+            self._send_replay(items)
+            self.session.expire_awaiting_rel()
+
+    # ================= takeover / kick / terminate =================
+    async def takeover_begin(self) -> Optional[Session]:
+        if self.session is None:
+            return None
+        self.conn_state = CONN_TAKING_OVER
+        return self.session.takeover()
+
+    async def takeover_end(self) -> list:
+        pendings = self._pendings
+        self._pendings = []
+        sess = self.session
+        self.session = None     # ownership moved
+        self.node.metrics.inc("session.takenover")
+        self.node.hooks.run("session.takenover", (self.clientinfo, sess))
+        if self.sid is not None:
+            self.node.broker.subscriber_down(self.sid)
+            self.sid = None
+        self.close("takenover")
+        return pendings
+
+    async def kick(self, reason: str) -> None:
+        if self.proto_ver == C.MQTT_V5:
+            rc = (C.RC_SESSION_TAKEN_OVER if reason == "discarded"
+                  else C.RC_ADMINISTRATIVE_ACTION)
+            self._send([P.Disconnect(reason_code=rc)])
+        self.will_msg = None if reason == "discarded" else self.will_msg
+        if reason == "discarded" and self.session is not None:
+            self.node.metrics.inc("session.discarded")
+            self.node.hooks.run("session.discarded",
+                                (self.clientinfo, self.session))
+            self.session = None
+        self.close(reason)
+
+    def terminate(self, reason: str) -> None:
+        """Connection closed (emqx_channel:terminate) — publish will,
+        park or drop the session, clean broker state."""
+        sess = self.session
+        park = (sess is not None and self.conn_state == CONN_CONNECTED
+                and sess.conf.session_expiry_interval > 0
+                and reason != "discarded")
+        if self.sid is not None:
+            if park:
+                # keep routes alive: detached session keeps enqueueing
+                sess.parked_sid = self.sid
+                self.node.broker.swap_subscriber(
+                    self.sid, ParkedSubscriber(sess, self.node))
+            else:
+                self.node.broker.subscriber_down(self.sid)
+            self.sid = None
+        if self.conn_state in (CONN_CONNECTED, CONN_DISCONNECTED):
+            self.node.cm.unregister_channel(self.clientid, self)
+        if self.will_msg is not None and reason not in ("takenover",):
+            self.node.broker.publish(self.will_msg)
+            self.will_msg = None
+        if sess is not None and self.conn_state == CONN_CONNECTED:
+            if park:
+                self.node.cm.park_session(self.clientid, sess)
+            else:
+                self.node.metrics.inc("session.terminated")
+                self.node.hooks.run("session.terminated",
+                                    (self.clientinfo, reason, sess))
+        if self.conn_state == CONN_CONNECTED:
+            self.node.metrics.inc("client.disconnected")
+            self.node.hooks.run("client.disconnected",
+                                (self.clientinfo, reason))
+        self.conn_state = CONN_DISCONNECTED
+        self.session = None
+
+    # ================= info =================
+    def _conninfo_map(self, pkt: P.Connect) -> dict:
+        return {"clientid": pkt.clientid, "username": pkt.username,
+                "proto_ver": pkt.proto_ver, "keepalive": pkt.keepalive,
+                "clean_start": pkt.clean_start,
+                "peername": self.conninfo.get("peername")}
+
+    def info(self) -> dict:
+        d = {
+            "clientid": self.clientid,
+            "username": self.clientinfo.get("username"),
+            "peername": self.conninfo.get("peername"),
+            "proto_ver": self.proto_ver,
+            "keepalive": self.keepalive,
+            "clean_start": self.clientinfo.get("clean_start", True),
+            "conn_state": self.conn_state,
+            "connected_at": self.connected_at,
+            "zone": self.zone,
+            "mountpoint": self.mountpoint,
+        }
+        if self.session is not None:
+            d["session"] = self.session.info()
+        return d

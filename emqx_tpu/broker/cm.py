@@ -1,0 +1,155 @@
+"""Connection/session manager: clientid → channel registry + session lifecycle.
+
+Parity: emqx_cm.erl — register/unregister channel, open_session with
+clean-start discard or takeover-resume (emqx_cm.erl:208-298), per-clientid
+locking (emqx_cm_locker), kick/discard. The reference's 2-phase
+`{takeover,'begin'/'end'}` call to the old connection becomes two async
+callbacks on the old channel object.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional, Protocol
+
+from emqx_tpu.broker.session import Session, SessionConf
+
+
+class ChannelLike(Protocol):
+    async def takeover_begin(self) -> Optional[Session]: ...
+    async def takeover_end(self) -> list: ...
+    async def kick(self, reason: str) -> None: ...
+
+
+class ConnectionManager:
+    def __init__(self):
+        self._channels: dict[str, Any] = {}     # clientid -> channel
+        self._info: dict[str, dict] = {}        # clientid -> conn info map
+        self._locks: dict[str, asyncio.Lock] = {}
+        # detached persistent sessions (expiry > 0, connection gone)
+        self._detached: dict[str, Session] = {}
+        self._parked_at: dict[str, float] = {}
+        self.broker = None      # wired by Node for parked-session cleanup
+        self.max_count = 0
+
+    # ---- registry (emqx_cm:register_channel/3 :124-131) ----
+    def register_channel(self, clientid: str, channel: Any,
+                         info: Optional[dict] = None) -> None:
+        self._channels[clientid] = channel
+        self._info[clientid] = info or {}
+        self.max_count = max(self.max_count, len(self._channels))
+
+    def unregister_channel(self, clientid: str, channel: Any = None) -> None:
+        if channel is None or self._channels.get(clientid) is channel:
+            self._channels.pop(clientid, None)
+            self._info.pop(clientid, None)
+
+    def lookup_channel(self, clientid: str) -> Optional[Any]:
+        return self._channels.get(clientid)
+
+    def set_channel_info(self, clientid: str, info: dict) -> None:
+        if clientid in self._channels:
+            self._info[clientid] = info
+
+    def get_channel_info(self, clientid: str) -> Optional[dict]:
+        return self._info.get(clientid)
+
+    def all_channels(self) -> list[tuple[str, Any]]:
+        return list(self._channels.items())
+
+    def count(self) -> int:
+        return len(self._channels)
+
+    def _lock(self, clientid: str) -> asyncio.Lock:
+        return self._locks.setdefault(clientid, asyncio.Lock())
+
+    # ---- session lifecycle (emqx_cm:open_session/3 :208-240) ----
+    async def open_session(self, clean_start: bool, clientid: str,
+                           conf: SessionConf,
+                           new_channel: Any) -> tuple[Session, bool]:
+        """Returns (session, session_present). Serialized per clientid
+        (the emqx_cm_locker analog)."""
+        async with self._lock(clientid):
+            if clean_start:
+                await self.discard_session(clientid)
+                return Session(clientid, conf), False
+            # try takeover from a live channel first
+            old = self._channels.get(clientid)
+            if old is not None and old is not new_channel:
+                session = await old.takeover_begin()
+                if session is not None:
+                    pendings = await old.takeover_end()
+                    self.unregister_channel(clientid, old)
+                    session.conf = conf
+                    for item in pendings:
+                        session.mqueue.insert(item)
+                    return session, True
+            detached = self._detached.pop(clientid, None)
+            self._parked_at.pop(clientid, None)
+            if detached is not None:
+                detached.conf = conf
+                return detached, True
+            return Session(clientid, conf), False
+
+    async def discard_session(self, clientid: str) -> None:
+        """Kick any existing channel and drop its session
+        (emqx_cm:discard_session)."""
+        old = self._channels.pop(clientid, None)
+        self._info.pop(clientid, None)
+        self.drop_parked(clientid)
+        if old is not None:
+            try:
+                await old.kick("discarded")
+            except Exception:
+                pass
+
+    async def kick_session(self, clientid: str) -> bool:
+        """Administrative kick (emqx_cm:kick_session)."""
+        old = self._channels.pop(clientid, None)
+        self._info.pop(clientid, None)
+        if old is None:
+            return False
+        try:
+            await old.kick("kicked")
+        except Exception:
+            pass
+        return True
+
+    # ---- persistent-session parking ----
+    def park_session(self, clientid: str, session: Session) -> None:
+        """Hold a session whose connection closed with expiry > 0; its
+        broker subscriptions stay live (sid re-pointed by the channel) so
+        offline messages keep enqueueing."""
+        import time
+        self._detached[clientid] = session
+        self._parked_at[clientid] = time.monotonic()
+
+    def drop_parked(self, clientid: str) -> None:
+        sess = self._detached.pop(clientid, None)
+        self._parked_at.pop(clientid, None)
+        if sess is not None and self.broker is not None:
+            sid = getattr(sess, "parked_sid", None)
+            if sid is not None:
+                self.broker.subscriber_down(sid)
+
+    def sweep_expired_sessions(self) -> int:
+        """Expire parked sessions past their session_expiry_interval
+        (the reference's session-expiry timer)."""
+        import time
+        now = time.monotonic()
+        gone = [cid for cid, sess in self._detached.items()
+                if now - self._parked_at.get(cid, now)
+                > sess.conf.session_expiry_interval]
+        for cid in gone:
+            self.drop_parked(cid)
+        return len(gone)
+
+    def parked_count(self) -> int:
+        return len(self._detached)
+
+    def stats_fun(self, stats) -> None:
+        stats.setstat("connections.count", len(self._channels),
+                      "connections.max")
+        stats.setstat("sessions.count",
+                      len(self._channels) + len(self._detached),
+                      "sessions.max")

@@ -1,0 +1,131 @@
+"""Config store: schema-defaulted nested map with zone overrides.
+
+Parity: emqx_config.erl (get/put with zone- and listener-scoped lookups,
+emqx_config.erl:63-100) + the mqtt/zone portions of emqx_schema.erl. The
+reference's HOCON files become plain dicts here (JSON/TOML-compatible);
+`emqx_tpu.utils.hocon` provides a HOCON-lite loader for file parity.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+# schema defaults — the reference's emqx_schema.erl zone/mqtt roots
+DEFAULTS: dict = {
+    "mqtt": {
+        "max_packet_size": 1024 * 1024,
+        "max_clientid_len": 65535,
+        "max_topic_levels": 128,
+        "max_qos_allowed": 2,
+        "max_topic_alias": 65535,
+        "retain_available": True,
+        "wildcard_subscription": True,
+        "shared_subscription": True,
+        "ignore_loop_deliver": False,
+        "strict_mode": False,
+        "response_information": "",
+        "server_keepalive": 0,           # 0 = accept client value
+        "keepalive_backoff": 0.75,
+        "max_subscriptions": 0,
+        "upgrade_qos": False,
+        "max_inflight": 32,
+        "retry_interval": 30,
+        "max_awaiting_rel": 100,
+        "await_rel_timeout": 300,
+        "session_expiry_interval": 7200,
+        "max_mqueue_len": 1000,
+        "mqueue_priorities": {},
+        "mqueue_default_priority": "lowest",
+        "mqueue_store_qos0": True,
+        "use_username_as_clientid": False,
+        "peer_cert_as_username": None,
+        "idle_timeout": 15,
+    },
+    "broker": {
+        "sys_msg_interval": 60,
+        "sys_heartbeat_interval": 30,
+        "shared_subscription_strategy": "round_robin",
+        "shared_dispatch_ack_enabled": False,
+        "route_batch_clean": True,
+        "rebuild_threshold": 256,
+        "device_min_batch": 4,
+        "perf": {"trie_compaction": True},
+    },
+    "zones": {},                 # zone name -> {mqtt: {...}} overrides
+    "listeners": {},             # name -> {type,bind,zone,...}
+    "authn": {"enable": False, "chain": []},
+    "authz": {"no_match": "allow", "deny_action": "ignore", "sources": []},
+    "retainer": {
+        "enable": True, "max_retained_messages": 0,
+        "max_payload_size": 1024 * 1024, "msg_expiry_interval": 0,
+        "msg_clear_interval": 0,
+    },
+    "delayed": {"enable": True, "max_delayed_messages": 0},
+    "flapping_detect": {
+        "enable": False, "max_count": 15, "window_time": 60,
+        "ban_time": 300,
+    },
+    "force_shutdown": {"max_mqueue_len": 10000},
+    "sysmon": {"os": {"sysmem_high_watermark": 0.7,
+                      "procmem_high_watermark": 0.05}},
+    "rule_engine": {"rules": []},
+    "cluster": {"name": "emqx_tpu", "discovery": "manual", "nodes": []},
+    "rpc": {"mode": "async", "tcp_client_num": 4},
+}
+
+
+def deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class Config:
+    def __init__(self, overrides: Optional[dict] = None):
+        self._c = deep_merge(copy.deepcopy(DEFAULTS), overrides or {})
+
+    def get(self, *path, default: Any = None) -> Any:
+        """get('mqtt') or get('mqtt', 'max_inflight')."""
+        cur: Any = self._c
+        for p in path:
+            if not isinstance(cur, dict) or p not in cur:
+                return default if default is not None else (
+                    {} if len(path) == 1 else None)
+            cur = cur[p]
+        return cur
+
+    def put(self, path: "tuple | list", value: Any) -> None:
+        cur = self._c
+        for p in path[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[path[-1]] = value
+
+    def get_zone(self, zone: Optional[str], *path, default: Any = None) -> Any:
+        """Zone-scoped lookup falling back to global (emqx_config:get_zone_conf)."""
+        if zone:
+            zconf = self._c.get("zones", {}).get(zone, {})
+            cur: Any = zconf
+            found = True
+            for p in path:
+                if not isinstance(cur, dict) or p not in cur:
+                    found = False
+                    break
+                cur = cur[p]
+            if found:
+                return cur
+        return self.get(*path, default=default)
+
+    def mqtt(self, zone: Optional[str] = None) -> dict:
+        base = self.get("mqtt")
+        if zone:
+            return deep_merge(base, self._c.get("zones", {})
+                              .get(zone, {}).get("mqtt", {}))
+        return base
+
+    def to_dict(self) -> dict:
+        return copy.deepcopy(self._c)

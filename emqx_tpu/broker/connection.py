@@ -1,0 +1,203 @@
+"""Per-client connection task + config-driven listeners.
+
+Parity: emqx_connection.erl (per-client recvloop with {active,N}-style
+read batching :318-345,404-516, keepalive + idle timeout, force-shutdown
+policy) and emqx_listeners.erl (listener lifecycle :126-138). One asyncio
+task per socket replaces the reference's per-connection BEAM process; the
+read loop drains whatever bytes are available and feeds the streaming frame
+parser, so a burst of packets is handled as one batch (the P10 batching
+window).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from emqx_tpu.broker.channel import Channel, ProtocolError
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import FrameError, FrameParser, serialize
+
+log = logging.getLogger("emqx_tpu.connection")
+
+READ_CHUNK = 65536
+
+
+class Connection:
+    def __init__(self, node, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, zone: Optional[str] = None):
+        self.node = node
+        self.reader = reader
+        self.writer = writer
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        sock = writer.get_extra_info("sockname") or ("?", 0)
+        self.parser = FrameParser(
+            max_size=node.config.mqtt(zone).get("max_packet_size"),
+            strict=node.config.mqtt(zone).get("strict_mode", False))
+        self.channel = Channel(
+            node, {"peername": peer, "sockname": sock, "zone": zone},
+            send=self._send_packets, close=self._request_close)
+        self.last_rx = time.monotonic()
+        self._closing: Optional[str] = None
+        self._timer_task: Optional[asyncio.Task] = None
+
+    # ---- outbound ----
+    def _send_packets(self, pkts: list[P.Packet]) -> None:
+        if self.writer.is_closing():
+            return
+        data = b"".join(serialize(p, self.channel.proto_ver) for p in pkts)
+        self.node.metrics.inc("bytes.sent", len(data))
+        self.writer.write(data)
+
+    def _request_close(self, reason: str) -> None:
+        if self._closing is None:
+            self._closing = reason
+            if not self.writer.is_closing():
+                self.writer.close()
+
+    # ---- main loop (emqx_connection:recvloop) ----
+    async def run(self) -> None:
+        self._timer_task = asyncio.ensure_future(self._timers())
+        reason = "closed"
+        try:
+            idle_timeout = self.node.config.mqtt(
+                self.channel.zone).get("idle_timeout", 15)
+            while self._closing is None:
+                timeout = (idle_timeout
+                           if self.channel.conn_state == "idle" else None)
+                try:
+                    data = await asyncio.wait_for(
+                        self.reader.read(READ_CHUNK), timeout)
+                except asyncio.TimeoutError:
+                    reason = "idle_timeout"
+                    break
+                if not data:
+                    reason = "closed"
+                    break
+                self.last_rx = time.monotonic()
+                self.node.metrics.inc("bytes.received", len(data))
+                try:
+                    pkts = self.parser.feed(data)
+                except FrameError as e:
+                    reason = f"frame_error:{e.code}"
+                    self._frame_error_out(e)
+                    break
+                for pkt in pkts:
+                    try:
+                        await self.channel.handle_in(pkt)
+                    except ProtocolError as e:
+                        reason = f"protocol_error:0x{e.rc:02x}"
+                        self._protocol_error_out(e)
+                        break
+                if pkts:
+                    await self._drain()
+            reason = self._closing or reason
+        except (ConnectionResetError, BrokenPipeError):
+            reason = "closed"
+        except asyncio.CancelledError:
+            reason = "shutdown"
+        except Exception:
+            log.exception("connection crashed")
+            reason = "internal_error"
+        finally:
+            if self._timer_task:
+                self._timer_task.cancel()
+            self.channel.terminate(self._closing or reason)
+            try:
+                if not self.writer.is_closing():
+                    self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+
+    def _frame_error_out(self, e: FrameError) -> None:
+        if self.channel.proto_ver == C.MQTT_V5 and \
+                self.channel.conn_state == "connected":
+            self._send_packets([P.Disconnect(
+                reason_code=C.RC_MALFORMED_PACKET)])
+
+    def _protocol_error_out(self, e: ProtocolError) -> None:
+        if self.channel.proto_ver == C.MQTT_V5 and \
+                self.channel.conn_state == "connected":
+            self._send_packets([P.Disconnect(reason_code=e.rc)])
+        self._request_close(f"protocol_error_0x{e.rc:02x}")
+
+    async def _drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            self._request_close("closed")
+
+    # ---- keepalive + retry timers (emqx_channel timer table) ----
+    async def _timers(self) -> None:
+        backoff = self.node.config.mqtt(
+            self.channel.zone).get("keepalive_backoff", 0.75)
+        retry_iv = self.node.config.mqtt(
+            self.channel.zone).get("retry_interval", 30)
+        last_retry = time.monotonic()
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            ka = self.channel.keepalive
+            if (ka and self.channel.conn_state == "connected"
+                    and now - self.last_rx > ka * 2 * backoff):
+                if self.channel.proto_ver == C.MQTT_V5:
+                    self._send_packets([P.Disconnect(
+                        reason_code=C.RC_KEEP_ALIVE_TIMEOUT)])
+                self._request_close("keepalive_timeout")
+                return
+            if retry_iv and now - last_retry >= retry_iv:
+                last_retry = now
+                self.channel.retry_deliveries()
+
+
+class Listener:
+    """One TCP listener (emqx_listeners:start_listener/3)."""
+
+    def __init__(self, node, *, bind: str = "0.0.0.0", port: int = 1883,
+                 zone: Optional[str] = None, max_connections: int = 1024000,
+                 name: str = "tcp:default"):
+        self.node = node
+        self.bind = bind
+        self.port = port
+        self.zone = zone
+        self.name = name
+        self.max_connections = max_connections
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set[asyncio.Task] = set()
+        self.current_conns = 0
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        if self.current_conns >= self.max_connections:
+            writer.close()
+            return
+        self.current_conns += 1
+        conn = Connection(self.node, reader, writer, self.zone)
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await conn.run()
+        finally:
+            self.current_conns -= 1
+            self._conns.discard(task)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.bind, self.port)
+        if self.port == 0:   # ephemeral port for tests
+            self.port = self._server.sockets[0].getsockname()[1]
+        log.info("listener %s started on %s:%d", self.name, self.bind,
+                 self.port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conns):
+            t.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)

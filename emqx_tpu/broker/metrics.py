@@ -1,0 +1,147 @@
+"""Broker metrics: named lock-free counters + periodic stats gauges.
+
+Parity: emqx_metrics.erl (counters array behind persistent_term,
+packets.* / messages.* / bytes.* / delivery.* names, :241-258) and
+emqx_stats.erl (periodic gauge table fed by stats_funs).
+
+Python ints under the GIL give the same practical property the reference
+gets from `counters:add` — wait-free increments on the hot path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+# canonical metric names (emqx_metrics.erl defines ~90; same families here)
+BYTES_METRICS = ["bytes.received", "bytes.sent"]
+PACKET_METRICS = [
+    "packets.received", "packets.sent",
+    "packets.connect.received", "packets.connack.sent",
+    "packets.connack.error", "packets.connack.auth_error",
+    "packets.publish.received", "packets.publish.sent",
+    "packets.publish.error", "packets.publish.auth_error",
+    "packets.publish.dropped",
+    "packets.puback.received", "packets.puback.sent",
+    "packets.puback.missed",
+    "packets.pubrec.received", "packets.pubrec.sent",
+    "packets.pubrec.missed",
+    "packets.pubrel.received", "packets.pubrel.sent",
+    "packets.pubrel.missed",
+    "packets.pubcomp.received", "packets.pubcomp.sent",
+    "packets.pubcomp.missed",
+    "packets.subscribe.received", "packets.suback.sent",
+    "packets.subscribe.error", "packets.subscribe.auth_error",
+    "packets.unsubscribe.received", "packets.unsuback.sent",
+    "packets.unsubscribe.error",
+    "packets.pingreq.received", "packets.pingresp.sent",
+    "packets.disconnect.received", "packets.disconnect.sent",
+    "packets.auth.received", "packets.auth.sent",
+]
+MESSAGE_METRICS = [
+    "messages.received", "messages.sent",
+    "messages.qos0.received", "messages.qos0.sent",
+    "messages.qos1.received", "messages.qos1.sent",
+    "messages.qos2.received", "messages.qos2.sent",
+    "messages.publish", "messages.dropped",
+    "messages.dropped.await_pubrel_timeout",
+    "messages.dropped.no_subscribers",
+    "messages.forward", "messages.delayed", "messages.delivered",
+    "messages.acked", "messages.retained",
+]
+DELIVERY_METRICS = [
+    "delivery.dropped", "delivery.dropped.no_local",
+    "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
+    "delivery.dropped.queue_full", "delivery.dropped.expired",
+]
+CLIENT_METRICS = [
+    "client.connect", "client.connack", "client.connected",
+    "client.authenticate", "client.auth.anonymous", "client.authorize",
+    "client.subscribe", "client.unsubscribe", "client.disconnected",
+]
+SESSION_METRICS = [
+    "session.created", "session.resumed", "session.takenover",
+    "session.discarded", "session.terminated",
+]
+AUTHZ_METRICS = ["authorization.allow", "authorization.deny",
+                 "authorization.cache_hit"]
+ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS +
+               DELIVERY_METRICS + CLIENT_METRICS + SESSION_METRICS +
+               AUTHZ_METRICS)
+
+
+class Metrics:
+    def __init__(self):
+        self._c: dict[str, int] = {name: 0 for name in ALL_METRICS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        try:
+            self._c[name] += n
+        except KeyError:
+            self._c[name] = n
+
+    def val(self, name: str) -> int:
+        return self._c.get(name, 0)
+
+    def all(self) -> dict[str, int]:
+        return dict(self._c)
+
+    # packet-type helpers (emqx_metrics:inc_recv/inc_sent)
+    def inc_recv(self, type_name: str, nbytes: int = 0) -> None:
+        self.inc("packets.received")
+        self.inc(f"packets.{type_name.lower()}.received")
+        if nbytes:
+            self.inc("bytes.received", nbytes)
+
+    def inc_sent(self, type_name: str, nbytes: int = 0) -> None:
+        self.inc("packets.sent")
+        self.inc(f"packets.{type_name.lower()}.sent")
+        if nbytes:
+            self.inc("bytes.sent", nbytes)
+
+    def inc_msg_recv(self, qos: int) -> None:
+        self.inc("messages.received")
+        self.inc(f"messages.qos{min(qos,2)}.received")
+
+    def inc_msg_sent(self, qos: int) -> None:
+        self.inc("messages.sent")
+        self.inc(f"messages.qos{min(qos,2)}.sent")
+
+
+class Stats:
+    """Gauge table + registered stats functions sampled periodically
+    (emqx_stats.erl; emqx_broker:stats_fun/0 emqx_broker.erl:403-412)."""
+
+    GAUGES = [
+        "connections.count", "connections.max",
+        "live_connections.count", "live_connections.max",
+        "sessions.count", "sessions.max",
+        "topics.count", "topics.max",
+        "subscribers.count", "subscribers.max",
+        "subscriptions.count", "subscriptions.max",
+        "subscriptions.shared.count", "subscriptions.shared.max",
+        "retained.count", "retained.max",
+        "delayed.count", "delayed.max",
+    ]
+
+    def __init__(self):
+        self._g: dict[str, int] = {n: 0 for n in self.GAUGES}
+        self._funs: list[Callable[["Stats"], None]] = []
+
+    def setstat(self, name: str, val: int, max_name: Optional[str] = None) -> None:
+        self._g[name] = val
+        if max_name:
+            self._g[max_name] = max(self._g.get(max_name, 0), val)
+
+    def getstat(self, name: str) -> int:
+        return self._g.get(name, 0)
+
+    def register_stats_fun(self, fn: Callable[["Stats"], None]) -> None:
+        self._funs.append(fn)
+
+    def sample(self) -> dict[str, int]:
+        for fn in list(self._funs):
+            fn(self)
+        return dict(self._g)

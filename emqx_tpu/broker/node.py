@@ -1,0 +1,74 @@
+"""Broker node: the composition root bundling all subsystems.
+
+Parity: the emqx application + emqx_sup supervision tree
+(apps/emqx/src/emqx_sup.erl:64-79) — here a plain object graph assembled at
+boot, since asyncio tasks replace the supervised process tree. Also carries
+the facade API the reference exports from emqx.erl:25-52
+(subscribe/publish/topics/hook/...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.metrics import Metrics, Stats
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.router import Router
+
+
+class Node:
+    def __init__(self, config: Optional[dict] = None, *,
+                 use_device: bool = False, name: str = "emqx_tpu@127.0.0.1"):
+        from emqx_tpu.broker.config import Config
+        self.name = name
+        self.config = config if hasattr(config, "get_zone") else Config(config)
+        self.hooks = Hooks()
+        self.metrics = Metrics()
+        self.stats = Stats()
+        perf = self.config.get("broker") or {}
+        self.router = Router(
+            use_device=use_device,
+            rebuild_threshold=perf.get("rebuild_threshold", 256),
+            device_min_batch=perf.get("device_min_batch", 4))
+        self.broker = Broker(
+            router=self.router, hooks=self.hooks, metrics=self.metrics,
+            shared_strategy=perf.get("shared_subscription_strategy",
+                                     "round_robin"),
+            shared_dispatch_ack=perf.get("shared_dispatch_ack_enabled",
+                                         False))
+        self.cm = ConnectionManager()
+        self.cm.broker = self.broker
+        self.stats.register_stats_fun(self.broker.stats_fun)
+        self.stats.register_stats_fun(self.cm.stats_fun)
+        self.listeners: list = []
+        self._apps: list = []      # started feature apps (retainer, ...)
+
+    # ---- facade (emqx.erl) ----
+    def publish(self, msg: Message) -> int:
+        return self.broker.publish(msg)
+
+    def topics(self) -> list[str]:
+        return self.router.topics()
+
+    def hook(self, name: str, action, priority: int = 0) -> None:
+        self.hooks.add(name, action, priority)
+
+    def unhook(self, name: str, action_or_tag) -> None:
+        self.hooks.delete(name, action_or_tag)
+
+    def run_hook(self, name: str, args: tuple = ()) -> None:
+        self.hooks.run(name, args)
+
+    def register_app(self, app: Any) -> Any:
+        """Attach a feature app (retainer, delayed, rule engine, ...)."""
+        self._apps.append(app)
+        return app
+
+    def get_app(self, cls) -> Optional[Any]:
+        for a in self._apps:
+            if isinstance(a, cls):
+                return a
+        return None

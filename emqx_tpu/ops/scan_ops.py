@@ -25,6 +25,12 @@ _LT = np.tril(np.ones((BS, BS), np.float32))
 def cumsum_blocked(x: jax.Array) -> jax.Array:
     """Inclusive prefix sum of a 1D int32 array (any length) via MXU blocks."""
     n = x.shape[0]
+    # float32 accumulation is exact only up to 2^24; inputs are 0/1 flags so
+    # the running sum is bounded by n (static shape → checked at trace time)
+    if n > (1 << 24):
+        raise ValueError(
+            f"cumsum_blocked: length {n} exceeds the float32-exact bound "
+            f"2^24; shrink batch*slot_cap or split the scan")
     nb = -(-n // BS)
     pad = nb * BS - n
     xb = jnp.pad(x, (0, pad)).reshape(nb, BS).astype(jnp.float32)

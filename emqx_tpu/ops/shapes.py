@@ -169,6 +169,15 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
         rank = pos - run_start
         if int(rank.max(initial=0)) < BK:
             break
+        if bucket_capacity is not None:
+            # caller pinned the bucket shape (e.g. for uniform sharded
+            # stacking): growing would silently diverge from sibling shards
+            err = ShapeCapacityError(
+                f"bucket_capacity={bucket_capacity} overflows (>{BK} shapes "
+                f"hash to one bucket); rebuild every shard with "
+                f"bucket_capacity={2 * NB}")
+            err.needed_capacity = 2 * NB
+            raise err
         NB *= 2
         if NB > 1 << 28:
             raise MemoryError("shape bucket table too large")

@@ -1,0 +1,366 @@
+"""Unit tests: hooks, mqueue, inflight, session, router, pubsub engine.
+
+Mirrors the reference suites emqx_hooks_SUITE, emqx_mqueue_SUITE,
+emqx_inflight_SUITE, emqx_session_SUITE, emqx_router_SUITE,
+emqx_broker_SUITE, emqx_shared_sub_SUITE.
+"""
+
+import pytest
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.inflight import Inflight
+from emqx_tpu.broker.message import Message, base62_decode, base62_encode, make
+from emqx_tpu.broker.mqueue import MQueue, MQueueOpts
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.session import Session, SessionConf, SessionError
+from emqx_tpu.mqtt import constants as C
+
+
+# ---------- hooks ----------
+
+class TestHooks:
+    def test_priority_order_and_fifo(self):
+        h = Hooks()
+        seen = []
+        h.add("client.connected", lambda: seen.append("low"), priority=0)
+        h.add("client.connected", lambda: seen.append("hi"), priority=10)
+        h.add("client.connected", lambda: seen.append("low2"), priority=0)
+        h.run("client.connected")
+        assert seen == ["hi", "low", "low2"]
+
+    def test_run_stop_halts_chain(self):
+        h = Hooks()
+        seen = []
+        h.add("x", lambda: (seen.append(1), "stop")[1])
+        h.add("x", lambda: seen.append(2))
+        h.run("x")
+        assert seen == [1]
+
+    def test_run_fold_threads_acc(self):
+        h = Hooks()
+        h.add("f", lambda a, acc: ("ok", acc + a))
+        h.add("f", lambda a, acc: ("ok", acc * 2))
+        assert h.run_fold("f", (3,), 1) == 8
+
+    def test_run_fold_stop(self):
+        h = Hooks()
+        h.add("f", lambda acc: ("stop", "final"), priority=5)
+        h.add("f", lambda acc: ("ok", "never"))
+        assert h.run_fold("f", (), "init") == "final"
+
+    def test_delete_by_tag(self):
+        h = Hooks()
+        seen = []
+        h.add("x", lambda: seen.append(1), tag="t1")
+        h.delete("x", "t1")
+        h.run("x")
+        assert seen == []
+
+    def test_filter_skips(self):
+        h = Hooks()
+        seen = []
+        h.add("x", lambda v: seen.append(v), filter=lambda v: v > 0)
+        h.run("x", (-1,))
+        h.run("x", (2,))
+        assert seen == [2]
+
+
+# ---------- message ----------
+
+class TestMessage:
+    def test_guid_monotone_and_base62(self):
+        a, b = Message(topic="t"), Message(topic="t")
+        assert b.id > a.id
+        assert base62_decode(base62_encode(a.id)) == a.id
+
+    def test_expiry(self):
+        m = make("c", 1, "t", b"x",
+                 headers={"properties": {"message_expiry_interval": 100}})
+        assert not m.is_expired()
+        m.ts -= 200_000
+        assert m.is_expired()
+
+    def test_flags(self):
+        m = make("c", 0, "t", b"", flags={"retain": True})
+        assert m.retain and not m.dup
+        assert make("c", 0, "$SYS/x", b"").is_sys
+
+
+# ---------- mqueue ----------
+
+class TestMQueue:
+    def test_fifo_and_drop_oldest(self):
+        q = MQueue(MQueueOpts(max_len=3))
+        for i in range(5):
+            q.insert(make("c", 1, "t", str(i).encode()))
+        assert len(q) == 3 and q.dropped == 2
+        assert [m.payload for m in q.to_list()] == [b"2", b"3", b"4"]
+
+    def test_priorities(self):
+        q = MQueue(MQueueOpts(max_len=10, priorities={"hi": 2, "lo": 1}))
+        q.insert(make("c", 1, "lo", b"a"))
+        q.insert(make("c", 1, "hi", b"b"))
+        q.insert(make("c", 1, "other", b"c"))   # default lowest
+        assert q.out().topic == "hi"
+        assert q.out().topic == "lo"
+        assert q.out().topic == "other"
+
+    def test_store_qos0_off(self):
+        q = MQueue(MQueueOpts(store_qos0=False))
+        dropped = q.insert(make("c", 0, "t", b""))
+        assert dropped is not None and len(q) == 0
+
+
+# ---------- inflight ----------
+
+class TestInflight:
+    def test_window(self):
+        inf = Inflight(2)
+        inf.insert(1, "a")
+        inf.insert(2, "b")
+        assert inf.is_full() and inf.contain(1)
+        with pytest.raises(KeyError):
+            inf.insert(1, "dup")
+        assert inf.delete(1) == "a"
+        assert not inf.is_full()
+        assert [p for p, _ in inf.items()] == [2]
+
+
+# ---------- session ----------
+
+def qos1_sub():
+    return {"qos": 1}
+
+
+class TestSession:
+    def test_qos0_passthrough(self):
+        s = Session("c1")
+        out = s.deliver([(make("p", 0, "t", b"x"), {"qos": 0})])
+        assert out == [(None, out[0][1])]
+
+    def test_qos1_window_and_ack(self):
+        s = Session("c1", SessionConf(max_inflight=2))
+        msgs = [(make("p", 1, "t", bytes([i])), qos1_sub()) for i in range(4)]
+        out = s.deliver(msgs)
+        assert [p for p, _ in out] == [1, 2]
+        assert len(s.mqueue) == 2
+        s.puback(1)
+        refill = s.dequeue()
+        assert len(refill) == 1 and refill[0][0] == 3  # counter continues
+        with pytest.raises(SessionError):
+            s.puback(99)
+
+    def test_qos2_out_flow(self):
+        s = Session("c1")
+        (pid, _m), = s.deliver([(make("p", 2, "t", b"x"), {"qos": 2})])
+        s.pubrec(pid)
+        with pytest.raises(SessionError):
+            s.pubrec(pid)   # duplicate PUBREC → in use
+        s.pubcomp(pid)
+        assert s.inflight.is_empty()
+
+    def test_qos2_in_awaiting_rel(self):
+        s = Session("c1", SessionConf(max_awaiting_rel=1))
+        s.publish_qos2(10)
+        with pytest.raises(SessionError):
+            s.publish_qos2(10)
+        with pytest.raises(SessionError):  # max_awaiting_rel
+            s.publish_qos2(11)
+        s.pubrel(10)
+        with pytest.raises(SessionError):
+            s.pubrel(10)
+
+    def test_qos_downgrade_and_upgrade(self):
+        s = Session("c1")
+        out = s.deliver([(make("p", 2, "t", b""), {"qos": 1})])
+        assert out[0][1].qos == 1
+        s2 = Session("c2", SessionConf(upgrade_qos=True))
+        out = s2.deliver([(make("p", 0, "t", b""), {"qos": 1})])
+        assert out[0][1].qos == 1
+
+    def test_no_local(self):
+        s = Session("me")
+        out = s.deliver([(make("me", 0, "t", b""), {"qos": 0, "nl": 1})])
+        assert out == []
+
+    def test_replay_marks_dup(self):
+        s = Session("c1")
+        (pid, _), = s.deliver([(make("p", 1, "t", b"x"), qos1_sub())])
+        rep = s.replay()
+        assert rep[0][0] == pid and rep[0][2].dup
+
+    def test_dequeue_interleaves_qos0(self):
+        # regression: QoS0 entries in the mqueue must come out of dequeue
+        # as (0, msg) and not be silently dropped after an ack refill
+        s = Session("c1", SessionConf(max_inflight=1))
+        s.deliver([(make("p", 1, "t", b"a"), qos1_sub())])   # fills window
+        s.enqueue([(make("p", 0, "t", b"z0"), {"qos": 0}),
+                   (make("p", 1, "t", b"b"), qos1_sub())])
+        s.puback(1)
+        out = s.dequeue()
+        assert [(pid, m.payload) for pid, m in out] == [(0, b"z0"),
+                                                        (2, b"b")]
+
+    def test_packet_id_wraps_and_skips_inflight(self):
+        s = Session("c1")
+        s.next_pkt_id = C.MAX_PACKET_ID
+        assert s.alloc_packet_id() == C.MAX_PACKET_ID
+        assert s.alloc_packet_id() == 1
+
+
+# ---------- router ----------
+
+class TestRouter:
+    def test_exact_and_wildcard(self):
+        r = Router(use_device=False)
+        r.add_route("a/b")
+        r.add_route("a/+")
+        r.add_route("a/#")
+        r.add_route("$SYS/#")
+        assert sorted(r.match("a/b")) == ["a/#", "a/+", "a/b"]
+        assert r.match("a/b/c") == ["a/#"]
+        assert r.match("$SYS/up") == ["$SYS/#"]
+        assert "a/#" not in r.match("$SYS/up")
+
+    def test_delete(self):
+        r = Router(use_device=False)
+        r.add_route("a/+")
+        assert r.delete_route("a/+") and not r.delete_route("a/+")
+        assert r.match("a/b") == []
+
+    def test_device_batch_with_delta(self):
+        r = Router(use_device=True, rebuild_threshold=4, device_min_batch=1)
+        for i in range(6):
+            r.add_route(f"dev/{i}/+")
+        r.rebuild()
+        r.add_route("dev/extra/#")      # delta add (host-matched)
+        r.delete_route("dev/0/+")       # delete since build
+        topics = ["dev/0/t", "dev/1/t", "dev/extra/x/y", "nomatch"]
+        got = r.match_batch(topics)
+        assert got[0] == []             # deleted filter filtered out
+        assert got[1] == ["dev/1/+"]
+        assert got[2] == ["dev/extra/#"]
+        assert got[3] == []
+        # equivalence with host oracle
+        for t, g in zip(topics, got):
+            assert sorted(g) == sorted(r.match(t))
+
+    def test_rebuild_threshold_triggers(self):
+        r = Router(use_device=True, rebuild_threshold=2, device_min_batch=1)
+        r.add_route("x/+")
+        r.add_route("y/+")
+        r.add_route("z/+")
+        got = r.match_batch(["x/1", "y/1", "z/1"])
+        assert got == [["x/+"], ["y/+"], ["z/+"]]
+        assert r.stats()["delta_since_build"] == 0
+
+
+# ---------- pubsub ----------
+
+class Collector:
+    def __init__(self, ack=True):
+        self.got = []
+        self.ack = ack
+
+    def deliver(self, f, m):
+        self.got.append((f, m))
+        return self.ack
+
+
+class TestBroker:
+    def test_publish_dispatch(self):
+        b = Broker(router=Router(use_device=False))
+        c1, c2 = Collector(), Collector()
+        s1 = b.register(c1, "c1")
+        s2 = b.register(c2, "c2")
+        b.subscribe(s1, "t/+", {"qos": 1})
+        b.subscribe(s2, "t/1", {"qos": 0})
+        n = b.publish(make("p", 1, "t/1", b"hello"))
+        assert n == 2 and len(c1.got) == 1 and len(c2.got) == 1
+        assert c1.got[0][1].headers["subopts"]["qos"] == 1
+
+    def test_publish_hook_deny(self):
+        b = Broker(router=Router(use_device=False))
+        b.hooks.add("message.publish",
+                    lambda m: ("stop", m.set_header("allow_publish", False)))
+        c = Collector()
+        sid = b.register(c)
+        b.subscribe(sid, "t")
+        assert b.publish(make("p", 0, "t", b"")) == 0
+        assert c.got == []
+
+    def test_unsubscribe_removes_route(self):
+        b = Broker(router=Router(use_device=False))
+        sid = b.register(Collector())
+        b.subscribe(sid, "a/+")
+        assert b.router.has_route("a/+")
+        assert b.unsubscribe(sid, "a/+")
+        assert not b.router.has_route("a/+")
+
+    def test_shared_round_robin(self):
+        b = Broker(router=Router(use_device=False),
+                   shared_strategy="round_robin")
+        cols = [Collector() for _ in range(3)]
+        for i, c in enumerate(cols):
+            sid = b.register(c, f"m{i}")
+            b.subscribe(sid, "$share/g/job/+", {"qos": 1})
+        for i in range(6):
+            assert b.publish(make("p", 1, "job/x", bytes([i]))) == 1
+        assert [len(c.got) for c in cols] == [2, 2, 2]
+
+    def test_shared_sticky(self):
+        b = Broker(router=Router(use_device=False), shared_strategy="sticky")
+        cols = [Collector() for _ in range(3)]
+        for c in cols:
+            b.subscribe(b.register(c), "$share/g/t")
+        for _ in range(5):
+            b.publish(make("p", 0, "t", b""))
+        counts = sorted(len(c.got) for c in cols)
+        assert counts == [0, 0, 5]
+
+    def test_shared_failover_with_ack(self):
+        b = Broker(router=Router(use_device=False), shared_strategy="random",
+                   shared_dispatch_ack=True)
+        dead, live = Collector(ack=False), Collector()
+        b.subscribe(b.register(dead), "$share/g/t")
+        b.subscribe(b.register(live), "$share/g/t")
+        for _ in range(4):
+            assert b.publish(make("p", 1, "t", b"")) == 1
+        assert len(live.got) == 4
+
+    def test_hash_clientid_stable(self):
+        b = Broker(router=Router(use_device=False),
+                   shared_strategy="hash_clientid")
+        cols = [Collector() for _ in range(3)]
+        for c in cols:
+            b.subscribe(b.register(c), "$share/g/t")
+        for _ in range(5):
+            b.publish(make("pub1", 0, "t", b""))
+        assert sorted(len(c.got) for c in cols) == [0, 0, 5]
+
+    def test_subscriber_down_cleanup(self):
+        b = Broker(router=Router(use_device=False))
+        sid = b.register(Collector(), "c")
+        b.subscribe(sid, "a/+")
+        b.subscribe(sid, "$share/g/b/+")
+        b.subscriber_down(sid)
+        assert b.subscription_count() == 0
+        assert not b.router.has_route("a/+")
+        assert not b.router.has_route("b/+")
+
+    def test_batch_matches_single(self):
+        b = Broker(router=Router(use_device=True, device_min_batch=1,
+                                 rebuild_threshold=2))
+        c = Collector()
+        sid = b.register(c)
+        for i in range(5):
+            b.subscribe(sid, f"s/{i}/+")
+        msgs = [make("p", 0, f"s/{i}/x", b"") for i in range(5)]
+        counts = b.publish_batch(msgs)
+        assert counts == [1] * 5 and len(c.got) == 5
+
+    def test_dropped_no_subscribers_metric(self):
+        b = Broker(router=Router(use_device=False))
+        b.publish(make("p", 0, "nobody/home", b""))
+        assert b.metrics.val("messages.dropped.no_subscribers") == 1

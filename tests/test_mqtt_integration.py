@@ -1,0 +1,375 @@
+"""End-to-end broker tests over real TCP sockets.
+
+Mirrors the reference's emqx_client_SUITE / emqx_mqtt_protocol_v5_SUITE:
+a live broker (Node + Listener) driven by the bundled asyncio client.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client, MqttError
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import packet as P
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture()
+def broker(loop):
+    node = Node()
+    listener = Listener(node, bind="127.0.0.1", port=0)
+    loop.run_until_complete(listener.start())
+    yield node, listener
+    loop.run_until_complete(listener.stop())
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 15))
+
+
+class TestConnect:
+    def test_connect_v4(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="c1")
+            ack = await c.connect()
+            assert ack.reason_code == 0 and not ack.session_present
+            await c.disconnect()
+        run(loop, go())
+        assert node.metrics.val("client.connected") == 1
+        assert node.metrics.val("client.disconnected") == 1
+
+    def test_connect_v5_props(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="c5", proto_ver=C.MQTT_V5)
+            ack = await c.connect()
+            assert ack.properties.get("shared_subscription_available") == 1
+            assert "receive_maximum" in ack.properties
+            await c.disconnect()
+        run(loop, go())
+
+    def test_v5_assigned_clientid(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="", proto_ver=C.MQTT_V5)
+            ack = await c.connect()
+            assert ack.properties.get("assigned_client_identifier")
+            await c.disconnect()
+        run(loop, go())
+
+    def test_v3_empty_clientid_no_cleanstart_rejected(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="", clean_start=False)
+            with pytest.raises(MqttError):
+                await c.connect()
+            await c.close()
+        run(loop, go())
+
+
+class TestPubSub:
+    def test_qos0_roundtrip(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            sub = Client(port=lst.port, clientid="sub")
+            await sub.connect()
+            ack = await sub.subscribe("t/+", qos=0)
+            assert ack.reason_codes == [0]
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            await pub.publish("t/1", b"hello")
+            m = await sub.recv()
+            assert m.topic == "t/1" and m.payload == b"hello" and m.qos == 0
+            await sub.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_qos1_roundtrip(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            sub = Client(port=lst.port, clientid="sub")
+            await sub.connect()
+            await sub.subscribe("a/b", qos=1)
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            ack = await pub.publish("a/b", b"x", qos=1)
+            assert isinstance(ack, P.Puback)
+            m = await sub.recv()
+            assert m.qos == 1 and m.packet_id
+            await sub.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+        assert node.metrics.val("messages.qos1.received") == 1
+        assert node.metrics.val("messages.acked") >= 1
+
+    def test_qos2_roundtrip(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            sub = Client(port=lst.port, clientid="sub")
+            await sub.connect()
+            await sub.subscribe("q2", qos=2)
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            comp = await pub.publish("q2", b"x", qos=2)
+            assert isinstance(comp, P.Pubcomp)
+            m = await sub.recv()
+            assert m.qos == 2 and m.payload == b"x"
+            await sub.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_qos_downgrade(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            sub = Client(port=lst.port, clientid="sub")
+            await sub.connect()
+            await sub.subscribe("d", qos=0)
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            await pub.publish("d", b"x", qos=1)
+            m = await sub.recv()
+            assert m.qos == 0
+            await sub.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_unsubscribe(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="c")
+            await c.connect()
+            await c.subscribe("u/#", qos=0)
+            un = await c.unsubscribe("u/#")
+            assert un.reason_codes == [] or un.reason_codes == [0]
+            pub = Client(port=lst.port, clientid="p")
+            await pub.connect()
+            await pub.publish("u/x", b"1")
+            with pytest.raises(asyncio.TimeoutError):
+                await c.recv(timeout=0.3)
+            await c.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_shared_subscription_balances(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            subs = []
+            for i in range(2):
+                s = Client(port=lst.port, clientid=f"m{i}")
+                await s.connect()
+                await s.subscribe("$share/g/work", qos=0)
+                subs.append(s)
+            pub = Client(port=lst.port, clientid="p")
+            await pub.connect()
+            for i in range(4):
+                await pub.publish("work", str(i).encode())
+            await asyncio.sleep(0.2)
+            counts = [s.messages.qsize() for s in subs]
+            assert sum(counts) == 4 and counts == [2, 2]
+            for s in subs:
+                await s.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_no_local_v5(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="me", proto_ver=C.MQTT_V5)
+            await c.connect()
+            await c.subscribe("nl/t", qos=0, opts={"nl": 1})
+            await c.publish("nl/t", b"self")
+            with pytest.raises(asyncio.TimeoutError):
+                await c.recv(timeout=0.3)
+            await c.disconnect()
+        run(loop, go())
+
+
+class TestSessionLifecycle:
+    def test_takeover_and_resume(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c1 = Client(port=lst.port, clientid="dev", clean_start=False,
+                        proto_ver=C.MQTT_V5,
+                        properties={"session_expiry_interval": 300})
+            await c1.connect()
+            await c1.subscribe("s/1", qos=1)
+            # second connection with same clientid takes over the session
+            c2 = Client(port=lst.port, clientid="dev", clean_start=False,
+                        proto_ver=C.MQTT_V5,
+                        properties={"session_expiry_interval": 300})
+            ack = await c2.connect()
+            assert ack.session_present
+            # subscription survived
+            pub = Client(port=lst.port, clientid="p")
+            await pub.connect()
+            await pub.publish("s/1", b"after", qos=1)
+            m = await c2.recv()
+            assert m.payload == b"after"
+            await c1.close()
+            await c2.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+        assert node.metrics.val("session.takenover") == 1
+
+    def test_offline_queue_then_resume(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c1 = Client(port=lst.port, clientid="dev", clean_start=False,
+                        proto_ver=C.MQTT_V5,
+                        properties={"session_expiry_interval": 300})
+            await c1.connect()
+            await c1.subscribe("off/q", qos=1)
+            await c1.close()        # abrupt close → session parked
+            await asyncio.sleep(0.1)
+            pub = Client(port=lst.port, clientid="p")
+            await pub.connect()
+            await pub.publish("off/q", b"queued", qos=1)
+            await pub.disconnect()
+            c2 = Client(port=lst.port, clientid="dev", clean_start=False,
+                        proto_ver=C.MQTT_V5,
+                        properties={"session_expiry_interval": 300})
+            ack = await c2.connect()
+            assert ack.session_present
+            m = await c2.recv()
+            assert m.payload == b"queued" and m.qos == 1
+            await c2.disconnect()
+        run(loop, go())
+
+    def test_clean_start_discards(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c1 = Client(port=lst.port, clientid="dev", clean_start=False,
+                        proto_ver=C.MQTT_V5,
+                        properties={"session_expiry_interval": 300})
+            await c1.connect()
+            await c1.subscribe("cs", qos=1)
+            await c1.close()
+            c2 = Client(port=lst.port, clientid="dev", clean_start=True)
+            ack = await c2.connect()
+            assert not ack.session_present
+            await c2.disconnect()
+        run(loop, go())
+
+    def test_will_message_on_abnormal_close(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            w = Client(port=lst.port, clientid="watcher")
+            await w.connect()
+            await w.subscribe("will/t", qos=0)
+            c = Client(port=lst.port, clientid="dying",
+                       will=P.Will(topic="will/t", payload=b"bye", qos=0))
+            await c.connect()
+            await c.close()     # abrupt close (no DISCONNECT) → will fires
+            m = await w.recv()
+            assert m.topic == "will/t" and m.payload == b"bye"
+            await w.disconnect()
+        run(loop, go())
+
+    def test_will_suppressed_on_clean_disconnect(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            w = Client(port=lst.port, clientid="watcher")
+            await w.connect()
+            await w.subscribe("will/t2", qos=0)
+            c = Client(port=lst.port, clientid="polite",
+                       will=P.Will(topic="will/t2", payload=b"bye"))
+            await c.connect()
+            await c.disconnect()    # clean → will dropped
+            with pytest.raises(asyncio.TimeoutError):
+                await w.recv(timeout=0.3)
+            await w.disconnect()
+        run(loop, go())
+
+    def test_kick_session(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="victim")
+            await c.connect()
+            assert await node.cm.kick_session("victim")
+            await asyncio.wait_for(c.closed.wait(), 5)
+            await c.close()
+        run(loop, go())
+
+
+class TestProtocolEdges:
+    def test_ping(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="c")
+            await c.connect()
+            await c.ping()
+            await asyncio.sleep(0.1)
+            await c.disconnect()
+        run(loop, go())
+        assert node.metrics.val("packets.pingresp.sent") == 1
+
+    def test_publish_before_connect_closes(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            r, w = await asyncio.open_connection("127.0.0.1", lst.port)
+            from emqx_tpu.mqtt.frame import serialize
+            w.write(serialize(P.Publish(topic="x", payload=b"y")))
+            data = await r.read(100)
+            assert data == b""      # closed without CONNACK
+            w.close()
+        run(loop, go())
+
+    def test_topic_alias_v5(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            sub = Client(port=lst.port, clientid="s")
+            await sub.connect()
+            await sub.subscribe("alias/t", qos=0)
+            pub = Client(port=lst.port, clientid="p", proto_ver=C.MQTT_V5)
+            await pub.connect()
+            await pub.publish("alias/t", b"one",
+                              properties={"topic_alias": 3})
+            await pub.publish("", b"two", properties={"topic_alias": 3})
+            assert (await sub.recv()).payload == b"one"
+            m = await sub.recv()
+            assert m.topic == "alias/t" and m.payload == b"two"
+            await sub.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+    def test_metrics_counters(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            c = Client(port=lst.port, clientid="c")
+            await c.connect()
+            await c.publish("m/t", b"x")
+            await c.disconnect()
+        run(loop, go())
+        assert node.metrics.val("packets.connect.received") == 1
+        assert node.metrics.val("messages.dropped.no_subscribers") == 1
+        assert node.metrics.val("bytes.received") > 0
