@@ -1,0 +1,9 @@
+"""Feature apps layered over the broker core via hooks.
+
+Parity: the reference's per-feature OTP applications (emqx_retainer,
+emqx_modules' delayed/rewrite/topic_metrics/event_message, emqx_rule_engine,
+emqx_authn/authz, ...). Each app is a plain object constructed with the
+`Node`, installing its hook callbacks in `load()` and removing them in
+`unload()` — the hook registry is the only coupling, exactly as in the
+reference (apps/emqx/src/emqx_hooks.erl call sites).
+"""

@@ -1,0 +1,241 @@
+"""Retained-message store with wildcard read on subscribe.
+
+Parity: apps/emqx_retainer — `message.publish` hook stores/clears retained
+messages (emqx_retainer.erl on_message_publish), `session.subscribed` hook
+dispatches matching retained messages to the new subscriber honoring the
+MQTT5 Retain-Handling subopt (emqx_retainer.erl dispatch/2), expiry via the
+v5 Message-Expiry-Interval property or the configured default
+(emqx_retainer_mnesia.erl expiry scan), and max_retained_messages /
+max_payload_size limits (emqx_retainer.erl:enabled checks).
+
+The reference's mnesia index-read for wildcard subscribe becomes a host
+nested-level trie over retained topic *names* (exact topics, so the walk is
+filter-driven); the bulk device matcher is not involved because retained
+reads are off the publish hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from emqx_tpu.broker.hooks import HP_RETAINER
+from emqx_tpu.broker.message import Message, now_ms
+from emqx_tpu.utils import topic as T
+
+
+class TopicIndex:
+    """Nested-dict trie over exact topic names; lookup by wildcard filter."""
+
+    _LEAF = object()
+
+    def __init__(self):
+        self._root: dict = {}
+        self._count = 0
+
+    def insert(self, topic: str) -> bool:
+        node = self._root
+        for w in T.tokens(topic):
+            node = node.setdefault(w, {})
+        if TopicIndex._LEAF in node:
+            return False
+        node[TopicIndex._LEAF] = topic
+        self._count += 1
+        return True
+
+    def delete(self, topic: str) -> bool:
+        path = []
+        node = self._root
+        for w in T.tokens(topic):
+            nxt = node.get(w)
+            if nxt is None:
+                return False
+            path.append((node, w))
+            node = nxt
+        if node.pop(TopicIndex._LEAF, None) is None:
+            return False
+        self._count -= 1
+        for parent, w in reversed(path):
+            if parent[w]:
+                break
+            del parent[w]
+        return True
+
+    def __len__(self) -> int:
+        return self._count
+
+    def match(self, filt: str) -> Iterator[str]:
+        """All stored topic names matching the filter (MQTT semantics incl.
+        the `$`-topic root-wildcard exclusion, emqx_topic.erl:66-69)."""
+        fw = T.tokens(filt)
+        exclude_dollar = fw[0] in (T.PLUS, T.HASH)
+
+        def walk(node: dict, i: int, depth: int):
+            if i == len(fw):
+                t = node.get(TopicIndex._LEAF)
+                if t is not None:
+                    yield t
+                return
+            w = fw[i]
+            if w == T.HASH:
+                # '#' matches remaining levels including zero
+                yield from collect(node, depth)
+                return
+            if w == T.PLUS:
+                for k, child in node.items():
+                    if k is TopicIndex._LEAF:
+                        continue
+                    if depth == 0 and exclude_dollar and k.startswith("$"):
+                        continue
+                    yield from walk(child, i + 1, depth + 1)
+                return
+            child = node.get(w)
+            if child is not None:
+                yield from walk(child, i + 1, depth + 1)
+
+        def collect(node: dict, depth: int):
+            for k, child in node.items():
+                if k is TopicIndex._LEAF:
+                    yield child
+                    continue
+                if depth == 0 and exclude_dollar and k.startswith("$"):
+                    continue
+                yield from collect(child, depth + 1)
+
+        yield from walk(self._root, 0, 0)
+
+
+class Retainer:
+    def __init__(self, node, conf: Optional[dict] = None):
+        self.node = node
+        c = dict(node.config.get("retainer") or {})
+        c.update(conf or {})
+        self.enable = c.get("enable", True)
+        self.max_retained = int(c.get("max_retained_messages", 0))
+        self.max_payload = int(c.get("max_payload_size", 1024 * 1024))
+        self.default_expiry = int(c.get("msg_expiry_interval", 0))  # s, 0=∞
+        self._store: dict[str, tuple[Message, Optional[int]]] = {}
+        self._index = TopicIndex()
+
+    # ---- app lifecycle ----
+    def load(self) -> "Retainer":
+        self.node.hooks.add("message.publish", self.on_message_publish,
+                            priority=HP_RETAINER, tag="retainer")
+        self.node.hooks.add("session.subscribed", self.on_session_subscribed,
+                            tag="retainer")
+        self.node.stats.register_stats_fun(self.stats_fun)
+        return self
+
+    def unload(self) -> None:
+        self.node.hooks.delete("message.publish", "retainer")
+        self.node.hooks.delete("session.subscribed", "retainer")
+
+    # ---- hooks ----
+    def on_message_publish(self, msg: Message):
+        if not self.enable or not msg.retain or msg.topic.startswith("$SYS/"):
+            return ("ok", msg)
+        if not msg.payload:
+            self.delete(msg.topic)
+            # empty retained publish clears the store and is NOT routed
+            # further with retain semantics; the message itself still
+            # propagates (spec: treated as normal publish w/o retention)
+            return ("ok", msg)
+        self._insert(msg)
+        return ("ok", msg)
+
+    def on_session_subscribed(self, clientinfo: dict, topic: str,
+                              subopts: dict):
+        if not self.enable:
+            return
+        rh = int(subopts.get("rh", 0))
+        is_new = bool(subopts.get("is_new", True))
+        if rh == 2 or (rh == 1 and not is_new):
+            return
+        if subopts.get("share"):
+            return      # shared subscriptions get no retained replay (spec)
+        chan = self.node.cm.lookup_channel(clientinfo.get("clientid", ""))
+        if chan is None:
+            return
+        opts = {k: v for k, v in subopts.items() if k != "is_new"}
+        for m in self.match(topic):
+            d = m.copy()
+            d.set_flag("retained", True)
+            d.headers["subopts"] = opts
+            chan.deliver(topic, d)
+
+    # ---- store ----
+    def _expire_at(self, msg: Message) -> Optional[int]:
+        exp = msg.expiry_interval()
+        if exp is None:
+            exp = self.default_expiry or None
+        return None if exp is None else msg.ts + exp * 1000
+
+    def _insert(self, msg: Message) -> bool:
+        t = msg.topic
+        if len(msg.payload) > self.max_payload:
+            self.node.metrics.inc("messages.retained.dropped")
+            return False
+        if (self.max_retained and t not in self._store
+                and len(self._store) >= self.max_retained):
+            self.node.metrics.inc("messages.retained.dropped")
+            return False
+        if t not in self._store:
+            self._index.insert(t)
+        self._store[t] = (msg.copy(), self._expire_at(msg))
+        self.node.metrics.inc("messages.retained")
+        return True
+
+    def delete(self, topic: str) -> bool:
+        if self._store.pop(topic, None) is None:
+            return False
+        self._index.delete(topic)
+        return True
+
+    def lookup(self, topic: str) -> Optional[Message]:
+        ent = self._store.get(topic)
+        if ent is None:
+            return None
+        msg, exp = ent
+        if exp is not None and now_ms() > exp:
+            self.delete(topic)
+            return None
+        return msg
+
+    def match(self, filt: str) -> list[Message]:
+        """All live retained messages matching a filter (wildcard read)."""
+        out = []
+        for t in list(self._index.match(filt)):
+            m = self.lookup(t)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def clean(self, filt: Optional[str] = None) -> int:
+        """Purge retained messages (all, or those matching a filter) —
+        emqx_retainer:clean/0, emqx_mgmt:clean_retained."""
+        if filt is None:
+            n = len(self._store)
+            self._store.clear()
+            self._index = TopicIndex()
+            return n
+        gone = list(self._index.match(filt))
+        for t in gone:
+            self.delete(t)
+        return len(gone)
+
+    def clean_expired(self) -> int:
+        now = now_ms()
+        stale = [t for t, (_, exp) in self._store.items()
+                 if exp is not None and now > exp]
+        for t in stale:
+            self.delete(t)
+        return len(stale)
+
+    def tick(self) -> None:
+        """Housekeeping hook (Node.sweep): expiry scan."""
+        self.clean_expired()
+
+    def retained_count(self) -> int:
+        return len(self._store)
+
+    def stats_fun(self, stats) -> None:
+        stats.setstat("retained.count", len(self._store), "retained.max")

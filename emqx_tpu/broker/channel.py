@@ -232,8 +232,15 @@ class Channel:
 
         # --- open session (clean-start discard / takeover)
         conf = session_conf_from(self.mqtt, expiry)
+        if pkt.proto_ver == C.MQTT_V5:
+            # MQTT-3.3.4-9: never exceed the client's Receive Maximum
+            rm = props.get("receive_maximum")
+            if rm:
+                conf.max_inflight = min(conf.max_inflight, int(rm))
         session, present = await self.node.cm.open_session(
             pkt.clean_start, clientid, conf, self)
+        session.inflight.max_size = conf.max_inflight
+        session.on_dropped = self._delivery_dropped
         self.session = session
         if present:
             self.node.metrics.inc("session.resumed")
@@ -352,13 +359,17 @@ class Channel:
             if self.proto_ver < C.MQTT_V5:
                 rc = C.RC_SUCCESS
             self._send([P.Puback(packet_id=pkt.packet_id, reason_code=rc)])
-        else:  # QoS2: ack first, publish on PUBREL (emqx_channel do_publish)
+        else:
+            # QoS2: publish immediately, track the packet id in awaiting_rel
+            # purely for duplicate suppression until PUBREL — the reference's
+            # method (emqx_session:publish/3); avoids buffering payloads
             try:
                 self.session.publish_qos2(pkt.packet_id)
-                self.session.extra_qos2 = getattr(self.session, "extra_qos2", {})
-                self.session.extra_qos2[pkt.packet_id] = msg
+                n = self.node.broker.publish(msg)
+                rc = C.RC_SUCCESS if n or self.proto_ver < C.MQTT_V5 \
+                    else C.RC_NO_MATCHING_SUBSCRIBERS
                 self._send([P.Pubrec(packet_id=pkt.packet_id,
-                                     reason_code=C.RC_SUCCESS)])
+                                     reason_code=rc)])
             except SessionError as e:
                 self.node.metrics.inc("packets.publish.dropped")
                 self._send([P.Pubrec(packet_id=pkt.packet_id,
@@ -415,10 +426,6 @@ class Channel:
     def _handle_pubrel(self, pkt: P.Pubrel) -> None:
         try:
             self.session.pubrel(pkt.packet_id)
-            msg = getattr(self.session, "extra_qos2", {}).pop(
-                pkt.packet_id, None)
-            if msg is not None:
-                self.node.broker.publish(msg)
             self._send([P.Pubcomp(packet_id=pkt.packet_id)])
         except SessionError:
             self.node.metrics.inc("packets.pubrel.missed")
@@ -478,6 +485,7 @@ class Channel:
         mounted_real = self._mount(real)
         group = popts.get("share")
         full = f"$share/{group}/{mounted_real}" if group else mounted_real
+        is_new = full not in self.session.subscriptions
         try:
             self.session.subscribe(full, popts)
         except SessionError as e:
@@ -485,8 +493,11 @@ class Channel:
         self.node.broker.subscribe(self.sid, full,
                                    {k: v for k, v in popts.items()
                                     if k != "share"})
+        # is_new feeds the retainer's Retain-Handling decision (rh=1 sends
+        # retained msgs only on a NEW subscription, MQTT5 [MQTT-3.3.1-10])
         self.node.hooks.run("session.subscribed",
-                            (self.clientinfo, mounted_real, popts))
+                            (self.clientinfo, mounted_real,
+                             dict(popts, is_new=is_new)))
         return qos  # granted QoS doubles as v5 success code 0..2
 
     def _handle_unsubscribe(self, pkt: P.Unsubscribe) -> None:
@@ -535,6 +546,14 @@ class Channel:
             self._send([P.Disconnect(reason_code=rc)])
         self.disconnect_reason = f"protocol_0x{rc:02x}"
         self.close(detail or f"disconnect_0x{rc:02x}")
+
+    def _delivery_dropped(self, msg: Message, reason: str) -> None:
+        """Session mqueue eviction (delivery.dropped hook,
+        emqx_session dropping path)."""
+        self.node.metrics.inc("delivery.dropped")
+        self.node.metrics.inc(f"delivery.dropped.{reason}")
+        self.node.hooks.run("delivery.dropped",
+                            (self.clientinfo, msg, reason))
 
     # ================= delivery (broker → client) =================
     def deliver(self, topic_filter: str, msg: Message) -> bool:

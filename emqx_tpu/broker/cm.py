@@ -81,8 +81,12 @@ class ConnectionManager:
                     pendings = await old.takeover_end()
                     self.unregister_channel(clientid, old)
                     session.conf = conf
-                    for item in pendings:
-                        session.mqueue.insert(item)
+                    # pendings are raw routed messages buffered during the
+                    # takeover window — run them through the session's
+                    # subopts enrichment (QoS cap, nl, rap) like any other
+                    # delivery (emqx_channel.erl:754-759)
+                    session.enqueue([(m, m.headers.get("subopts", {}))
+                                     for m in pendings])
                     return session, True
             detached = self._detached.pop(clientid, None)
             self._parked_at.pop(clientid, None)

@@ -62,6 +62,12 @@ DEFAULTS: dict = {
         "msg_clear_interval": 0,
     },
     "delayed": {"enable": True, "max_delayed_messages": 0},
+    "rewrite": [],               # [{action,source,re,dest}]
+    "topic_metrics": [],         # topic filters to meter
+    "event_message": {e: False for e in (
+        "client_connected", "client_disconnected", "session_subscribed",
+        "session_unsubscribed", "message_delivered", "message_acked",
+        "message_dropped")},
     "flapping_detect": {
         "enable": False, "max_count": 15, "window_time": 60,
         "ban_time": 300,

@@ -9,6 +9,7 @@ the facade API the reference exports from emqx.erl:25-52
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Optional
 
 from emqx_tpu.broker.cm import ConnectionManager
@@ -45,6 +46,33 @@ class Node:
         self.stats.register_stats_fun(self.cm.stats_fun)
         self.listeners: list = []
         self._apps: list = []      # started feature apps (retainer, ...)
+        self._timer_task: Optional[asyncio.Task] = None
+
+    # ---- periodic housekeeping (the reference's per-subsystem timers:
+    #      session expiry, retained expiry scan, delayed fire, stats) ----
+    def sweep(self) -> None:
+        """One housekeeping pass; also callable directly from tests."""
+        self.cm.sweep_expired_sessions()
+        self.stats.sample()
+        for app in self._apps:
+            tick = getattr(app, "tick", None)
+            if tick is not None:
+                tick()
+
+    async def _housekeeping(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            self.sweep()
+
+    def start_timers(self, interval: float = 1.0) -> None:
+        if self._timer_task is None:
+            self._timer_task = asyncio.ensure_future(
+                self._housekeeping(interval))
+
+    def stop_timers(self) -> None:
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+            self._timer_task = None
 
     # ---- facade (emqx.erl) ----
     def publish(self, msg: Message) -> int:

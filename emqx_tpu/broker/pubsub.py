@@ -147,11 +147,13 @@ class Broker:
 
     # ---- publish (emqx_broker:publish/1 :199-209) ----
     def publish(self, msg: Message) -> int:
-        """Run message.publish hooks, route, dispatch. Returns deliveries."""
+        """Run message.publish hooks, route, dispatch. Returns deliveries.
+
+        A hook setting allow_publish=false (delayed interception, rule-engine
+        republish guards) stops routing quietly — the reference just returns
+        [] without counting a drop (emqx_broker.erl:203-208)."""
         msg = self.hooks.run_fold("message.publish", (), msg)
         if msg is None or msg.get_header("allow_publish") is False:
-            self.metrics.inc("messages.dropped")
-            self.hooks.run("message.dropped", (msg, "publish.denied"))
             return 0
         self.metrics.inc("messages.publish")
         return self._route(msg, self.router.match(msg.topic))
@@ -163,8 +165,6 @@ class Broker:
         for m in msgs:
             mm = self.hooks.run_fold("message.publish", (), m)
             if mm is None or mm.get_header("allow_publish") is False:
-                self.metrics.inc("messages.dropped")
-                self.hooks.run("message.dropped", (mm, "publish.denied"))
                 live.append(None)
             else:
                 self.metrics.inc("messages.publish")
@@ -242,8 +242,11 @@ class Broker:
         if s == "sticky" and g.sticky in g.members:
             first = g.sticky
         elif s == "round_robin":
+            # pick-then-advance: first registered member gets the first
+            # message, matching the device kernel (ops.shared.pick_members)
+            # and the reference's counter start (emqx_shared_sub.erl:284-290)
+            first = sids[g.cursor % len(sids)]
             g.cursor = (g.cursor + 1) % len(sids)
-            first = sids[g.cursor]
         elif s == "hash_clientid":
             first = sids[_hash(msg.from_) % len(sids)]
         elif s == "hash_topic":

@@ -55,6 +55,15 @@ class Session:
         # counters (emqx_session:info/1)
         self.deliver_count = 0
         self.enqueue_count = 0
+        # wired by the owning channel: callable(msg, reason) invoked when
+        # the mqueue evicts a message (the reference's delivery.dropped
+        # hook + delivery.dropped.queue_full metric)
+        self.on_dropped: Optional[Callable[[Message, str], None]] = None
+
+    def _mq_insert(self, m: Message) -> None:
+        dropped = self.mqueue.insert(m)
+        if dropped is not None and self.on_dropped is not None:
+            self.on_dropped(dropped, "queue_full")
 
     # ---- packet id allocation (emqx_session:next_pkt_id) ----
     def alloc_packet_id(self) -> int:
@@ -119,7 +128,7 @@ class Session:
                 out.append((None, m))
             elif self.inflight.is_full():
                 self.enqueue_count += 1
-                self.mqueue.insert(m)
+                self._mq_insert(m)
             else:
                 pid = self.alloc_packet_id()
                 self.inflight.insert(pid, ("publish", m))
@@ -154,7 +163,7 @@ class Session:
             m = self._enrich(msg, subopts)
             if m is not None:
                 self.enqueue_count += 1
-                self.mqueue.insert(m)
+                self._mq_insert(m)
 
     # ---- acks (emqx_session:puback/pubrec/pubcomp) ----
     def puback(self, packet_id: int) -> Message:

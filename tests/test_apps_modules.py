@@ -1,0 +1,345 @@
+"""Tests for feature apps: retainer, delayed publish, rewrite, topic
+metrics, event messages.
+
+Mirrors the reference suites emqx_retainer_SUITE, emqx_delayed_SUITE,
+emqx_rewrite_SUITE, emqx_topic_metrics_SUITE, emqx_event_message_SUITE.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.apps.delayed import DelayedPublish
+from emqx_tpu.apps.event_message import EventMessage
+from emqx_tpu.apps.retainer import Retainer, TopicIndex
+from emqx_tpu.apps.rewrite import TopicRewrite
+from emqx_tpu.apps.topic_metrics import TopicMetrics
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.message import make, now_ms
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.mqtt import constants as C
+
+
+class Sink:
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, topic_filter, msg):
+        self.got.append((topic_filter, msg))
+        return True
+
+
+# ---------- TopicIndex ----------
+
+class TestTopicIndex:
+    def test_insert_match_delete(self):
+        ix = TopicIndex()
+        for t in ("a/b/c", "a/b/d", "a/x", "b", "$SYS/uptime"):
+            assert ix.insert(t)
+        assert not ix.insert("a/b/c")       # duplicate
+        assert sorted(ix.match("a/b/+")) == ["a/b/c", "a/b/d"]
+        assert sorted(ix.match("a/#")) == ["a/b/c", "a/b/d", "a/x"]
+        assert sorted(ix.match("#")) == ["a/b/c", "a/b/d", "a/x", "b"]
+        assert ix.match("$SYS/#") is not None
+        assert list(ix.match("$SYS/uptime")) == ["$SYS/uptime"]
+        assert ix.delete("a/b/c")
+        assert not ix.delete("a/b/c")
+        assert sorted(ix.match("a/b/+")) == ["a/b/d"]
+        assert len(ix) == 4
+
+    def test_dollar_excluded_from_root_wildcards(self):
+        ix = TopicIndex()
+        ix.insert("$SYS/x")
+        ix.insert("n/x")
+        assert list(ix.match("#")) == ["n/x"]
+        assert list(ix.match("+/x")) == ["n/x"]
+
+    def test_hash_matches_parent(self):
+        ix = TopicIndex()
+        ix.insert("sport")
+        ix.insert("sport/tennis")
+        assert sorted(ix.match("sport/#")) == ["sport", "sport/tennis"]
+
+
+# ---------- Retainer ----------
+
+class TestRetainer:
+    def setup_method(self):
+        self.node = Node()
+        self.ret = self.node.register_app(Retainer(self.node).load())
+
+    def test_store_and_clear_via_publish(self):
+        self.node.broker.publish(make("p", 0, "a/b", b"v1",
+                                      flags={"retain": True}))
+        assert self.ret.retained_count() == 1
+        assert self.ret.lookup("a/b").payload == b"v1"
+        # overwrite
+        self.node.broker.publish(make("p", 0, "a/b", b"v2",
+                                      flags={"retain": True}))
+        assert self.ret.lookup("a/b").payload == b"v2"
+        assert self.ret.retained_count() == 1
+        # empty payload clears
+        self.node.broker.publish(make("p", 0, "a/b", b"",
+                                      flags={"retain": True}))
+        assert self.ret.retained_count() == 0
+
+    def test_non_retained_not_stored(self):
+        self.node.broker.publish(make("p", 0, "a/b", b"v"))
+        assert self.ret.retained_count() == 0
+
+    def test_sys_not_stored(self):
+        self.node.broker.publish(make("p", 0, "$SYS/x", b"v",
+                                      flags={"retain": True}))
+        assert self.ret.retained_count() == 0
+
+    def test_wildcard_match(self):
+        for t in ("a/1", "a/2", "b/1"):
+            self.node.broker.publish(make("p", 0, t, b"x",
+                                          flags={"retain": True}))
+        assert len(self.ret.match("a/+")) == 2
+        assert len(self.ret.match("#")) == 3
+
+    def test_max_retained(self):
+        node = Node({"retainer": {"max_retained_messages": 2}})
+        ret = Retainer(node).load()
+        for t in ("a", "b", "c"):
+            node.broker.publish(make("p", 0, t, b"x", flags={"retain": True}))
+        assert ret.retained_count() == 2
+        # replacing an existing topic is allowed when full
+        node.broker.publish(make("p", 0, "a", b"y", flags={"retain": True}))
+        assert ret.lookup("a").payload == b"y"
+
+    def test_max_payload(self):
+        node = Node({"retainer": {"max_payload_size": 3}})
+        ret = Retainer(node).load()
+        node.broker.publish(make("p", 0, "a", b"xxxx", flags={"retain": True}))
+        assert ret.retained_count() == 0
+
+    def test_expiry(self):
+        m = make("p", 0, "a", b"x", flags={"retain": True},
+                 headers={"properties": {"message_expiry_interval": 100}})
+        m.ts = now_ms() - 200_000           # already expired
+        self.ret._insert(m)
+        assert self.ret.lookup("a") is None
+        assert self.ret.retained_count() == 0
+
+    def test_clean(self):
+        for t in ("a/1", "a/2", "b/1"):
+            self.node.broker.publish(make("p", 0, t, b"x",
+                                          flags={"retain": True}))
+        assert self.ret.clean("a/#") == 2
+        assert self.ret.retained_count() == 1
+        assert self.ret.clean() == 1
+        assert self.ret.retained_count() == 0
+
+
+class TestRetainerEndToEnd:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    @pytest.fixture()
+    def broker(self, loop):
+        node = Node()
+        node.register_app(Retainer(node).load())
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+        yield node, lst
+        loop.run_until_complete(lst.stop())
+
+    def test_retained_delivered_on_subscribe(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            await pub.publish("r/t", b"hello", qos=1, retain=True)
+            sub = Client(port=lst.port, clientid="sub", proto_ver=C.MQTT_V5)
+            await sub.connect()
+            await sub.subscribe("r/+", qos=1)
+            m = await sub.recv()
+            assert m.topic == "r/t" and m.payload == b"hello"
+            assert m.retain          # retained delivery keeps the flag
+            await pub.disconnect()
+            await sub.disconnect()
+        loop.run_until_complete(asyncio.wait_for(go(), 15))
+
+    def test_rh_never(self, loop, broker):
+        node, lst = broker
+
+        async def go():
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            await pub.publish("r/t", b"hello", retain=True)
+            sub = Client(port=lst.port, clientid="sub", proto_ver=C.MQTT_V5)
+            await sub.connect()
+            await sub.subscribe("r/t", qos=0, opts={"rh": 2})
+            with pytest.raises(asyncio.TimeoutError):
+                await sub.recv(timeout=0.3)
+            await pub.disconnect()
+            await sub.disconnect()
+        loop.run_until_complete(asyncio.wait_for(go(), 15))
+
+
+# ---------- Delayed ----------
+
+class TestDelayed:
+    def setup_method(self):
+        self.node = Node()
+        self.d = self.node.register_app(DelayedPublish(self.node).load())
+
+    def test_intercept_and_fire(self):
+        sink = Sink()
+        sid = self.node.broker.register(sink, "s")
+        self.node.broker.subscribe(sid, "a/b")
+        n = self.node.broker.publish(make("p", 0, "$delayed/5/a/b", b"x"))
+        assert n == 0 and not sink.got
+        assert self.d.count() == 1
+        assert self.d.tick(now_ms()) == 0          # not due yet
+        assert self.d.tick(now_ms() + 6000) == 1   # due
+        assert sink.got and sink.got[0][1].topic == "a/b"
+
+    def test_malformed_dropped(self):
+        for bad in ("$delayed/x/a", "$delayed/5", "$delayed//a",
+                    "$delayed/99999999999/a"):
+            assert self.node.broker.publish(make("p", 0, bad, b"x")) == 0
+        assert self.d.count() == 0
+        assert self.node.metrics.val("messages.delayed.dropped") == 4
+
+    def test_max_delayed(self):
+        node = Node({"delayed": {"max_delayed_messages": 1}})
+        d = DelayedPublish(node).load()
+        node.broker.publish(make("p", 0, "$delayed/5/a", b"x"))
+        node.broker.publish(make("p", 0, "$delayed/5/b", b"x"))
+        assert d.count() == 1
+
+    def test_list_delete(self):
+        self.node.broker.publish(make("p", 0, "$delayed/5/a", b"x"))
+        self.node.broker.publish(make("p", 0, "$delayed/9/b", b"x"))
+        items = self.d.list()
+        assert [i["topic"] for i in items] == ["a", "b"]
+        assert self.d.delete(items[0]["seq"])
+        assert self.d.count() == 1
+        assert self.d.tick(now_ms() + 10_000) == 1   # only 'b' fires
+
+    def test_ordering(self):
+        sink = Sink()
+        sid = self.node.broker.register(sink, "s")
+        self.node.broker.subscribe(sid, "#")
+        self.node.broker.publish(make("p", 0, "$delayed/9/late", b""))
+        self.node.broker.publish(make("p", 0, "$delayed/1/early", b""))
+        self.d.tick(now_ms() + 10_000)
+        assert [m.topic for _, m in sink.got] == ["early", "late"]
+
+
+# ---------- Rewrite ----------
+
+class TestRewrite:
+    def test_publish_rewrite(self):
+        node = Node({"rewrite": [
+            {"action": "publish", "source": "x/#",
+             "re": r"^x/y/(\d+)$", "dest": "z/y/$1"}]})
+        TopicRewrite(node).load()
+        sink = Sink()
+        sid = node.broker.register(sink, "s")
+        node.broker.subscribe(sid, "z/y/1")
+        node.broker.publish(make("p", 0, "x/y/1", b""))
+        assert sink.got and sink.got[0][1].topic == "z/y/1"
+        # non-matching regex passes through
+        node.broker.subscribe(sid, "x/y/abc")
+        node.broker.publish(make("p", 0, "x/y/abc", b""))
+        assert sink.got[-1][1].topic == "x/y/abc"
+
+    def test_chained_rules(self):
+        node = Node({"rewrite": [
+            {"action": "all", "source": "a", "re": "^a$", "dest": "b"},
+            {"action": "all", "source": "b", "re": "^b$", "dest": "c"}]})
+        rw = TopicRewrite(node).load()
+        assert rw._rewrite("a", "publish") == "c"
+
+    def test_subscribe_rewrite_preserves_share(self):
+        node = Node({"rewrite": [
+            {"action": "subscribe", "source": "old/#",
+             "re": r"^old/(.+)$", "dest": "new/$1"}]})
+        rw = TopicRewrite(node).load()
+        out = node.hooks.run_fold(
+            "client.subscribe", ({}, {}),
+            [("$share/g/old/t", {"qos": 1}), ("old/t", {"qos": 0})])
+        assert out[0][0] == "$share/g/new/t"
+        assert out[1][0] == "new/t"
+
+    def test_action_scoping(self):
+        node = Node({"rewrite": [
+            {"action": "subscribe", "source": "a", "re": "^a$",
+             "dest": "b"}]})
+        rw = TopicRewrite(node).load()
+        assert rw._rewrite("a", "publish") == "a"
+        assert rw._rewrite("a", "subscribe") == "b"
+
+
+# ---------- Topic metrics ----------
+
+class TestTopicMetrics:
+    def test_counts(self):
+        node = Node()
+        tm = TopicMetrics(node).load()
+        tm.register("t/#")
+        sink = Sink()
+        sid = node.broker.register(sink, "s")
+        node.broker.subscribe(sid, "t/1")
+        node.broker.publish(make("p", 1, "t/1", b""))
+        node.broker.publish(make("p", 0, "other", b""))
+        assert tm.val("t/#", "messages.in") == 1
+        assert tm.val("t/#", "messages.qos1.in") == 1
+        assert tm.val("t/#", "messages.out") == 1
+        # dropped: no subscriber for t/2
+        node.broker.publish(make("p", 0, "t/2", b""))
+        assert tm.val("t/#", "messages.dropped") == 1
+
+    def test_register_dedup_and_rates(self):
+        node = Node()
+        tm = TopicMetrics(node).load()
+        assert tm.register("a")
+        assert not tm.register("a")
+        node.broker.publish(make("p", 0, "a", b""))
+        tm.tick()
+        assert tm.rate("a", "messages.in") > 0
+        assert tm.deregister("a")
+        assert not tm.deregister("a")
+
+
+# ---------- Event message ----------
+
+class TestEventMessage:
+    def test_events_published(self):
+        node = Node({"event_message": {e: True for e in (
+            "client_connected", "session_subscribed", "message_dropped")}})
+        EventMessage(node).load()
+        sink = Sink()
+        sid = node.broker.register(sink, "watcher")
+        node.broker.subscribe(sid, "$event/#")
+        node.hooks.run("client.connected",
+                       ({"clientid": "c1", "username": "u"}, {}))
+        assert sink.got[-1][1].topic == "$event/client_connected"
+        body = json.loads(sink.got[-1][1].payload)
+        assert body["clientid"] == "c1"
+        node.hooks.run("session.subscribed",
+                       ({"clientid": "c1"}, "t/1", {"qos": 1, "is_new": True}))
+        body = json.loads(sink.got[-1][1].payload)
+        assert body["topic"] == "t/1" and "is_new" not in body["subopts"]
+        # message.dropped on a normal topic → event; event topics skipped
+        node.broker.publish(make("p", 0, "nobody/home", b""))
+        assert sink.got[-1][1].topic == "$event/message_dropped"
+
+    def test_disabled_by_default(self):
+        node = Node()
+        EventMessage(node).load()
+        sink = Sink()
+        sid = node.broker.register(sink, "watcher")
+        node.broker.subscribe(sid, "$event/#")
+        node.hooks.run("client.connected", ({"clientid": "c1"}, {}))
+        assert not sink.got

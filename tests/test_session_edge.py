@@ -1,0 +1,154 @@
+"""Regression tests for session/channel edge cases found in review:
+queue-full drop accounting, takeover pendings enrichment, v5 Receive
+Maximum, round-robin phase, QoS2 publish-on-PUBLISH."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.mqueue import MQueueOpts
+from emqx_tpu.broker.node import Node
+from emqx_tpu.broker.pubsub import Broker
+from emqx_tpu.broker.router import Router
+from emqx_tpu.broker.session import Session, SessionConf
+from emqx_tpu.client import Client
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import packet as P
+
+
+class TestQueueFullDrops:
+    def test_on_dropped_callback(self):
+        conf = SessionConf(max_inflight=1, mqueue=MQueueOpts(max_len=2))
+        s = Session("c", conf)
+        dropped = []
+        s.on_dropped = lambda m, r: dropped.append((m.topic, r))
+        msgs = [(make("p", 1, f"t/{i}", b"x"), {"qos": 1}) for i in range(5)]
+        s.deliver(msgs)
+        # 1 inflight + 2 queued + 2 evicted oldest-first
+        assert [t for t, _ in dropped] == ["t/1", "t/2"]
+        assert all(r == "queue_full" for _, r in dropped)
+
+
+class TestTakeoverEnrich:
+    def test_pendings_enriched(self):
+        cm = ConnectionManager()
+        loop = asyncio.new_event_loop()
+
+        class OldChan:
+            def __init__(self):
+                self.session = Session("c", SessionConf())
+
+            async def takeover_begin(self):
+                return self.session
+
+            async def takeover_end(self):
+                m = make("other", 2, "t", b"x")
+                m.headers["subopts"] = {"qos": 0}
+                return [m]
+
+        try:
+            sess, present = loop.run_until_complete(
+                cm.open_session(False, "c", SessionConf(), None))
+            assert not present
+            cm.register_channel("c", OldChan())
+            sess2, present = loop.run_until_complete(
+                cm.open_session(False, "c", SessionConf(), object()))
+            assert present
+            queued = sess2.mqueue.to_list()
+            assert len(queued) == 1
+            assert queued[0].qos == 0     # capped by subopts, not raw qos=2
+        finally:
+            loop.close()
+
+
+class TestRoundRobinPhase:
+    def test_first_member_first(self):
+        b = Broker(router=Router(use_device=False),
+                   shared_strategy="round_robin")
+
+        class Col:
+            def __init__(self):
+                self.got = []
+
+            def deliver(self, f, m):
+                self.got.append(m)
+                return True
+
+        cols = [Col(), Col()]
+        for c in cols:
+            b.subscribe(b.register(c), "$share/g/t")
+        b.publish(make("p", 0, "t", b""))
+        assert len(cols[0].got) == 1 and len(cols[1].got) == 0
+
+
+class TestNodeSweep:
+    def test_sweep_expires_parked_sessions(self):
+        node = Node()
+        sess = Session("c", SessionConf(session_expiry_interval=0))
+        node.cm.park_session("c", sess)
+        node.cm._parked_at["c"] = -10_000   # long past expiry
+        node.sweep()
+        assert node.cm.parked_count() == 0
+
+
+class TestReceiveMaximum:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_v5_receive_maximum_caps_inflight(self, loop):
+        node = Node()
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            c = Client(port=lst.port, clientid="rm", proto_ver=C.MQTT_V5,
+                       properties={"receive_maximum": 3})
+            await c.connect()
+            chan = node.cm.lookup_channel("rm")
+            assert chan.session.inflight.max_size == 3
+            await c.disconnect()
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
+
+
+class TestQos2PublishOnReceipt:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_duplicate_qos2_pid_not_republished(self, loop):
+        node = Node()
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            sub = Client(port=lst.port, clientid="sub")
+            await sub.connect()
+            await sub.subscribe("q", qos=0)
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            # send two QoS2 PUBLISH with the same pid, no PUBREL between:
+            # the broker must route only the first (dup suppression)
+            pub._send(P.Publish(topic="q", payload=b"1", qos=2, packet_id=7))
+            pub._send(P.Publish(topic="q", payload=b"1", qos=2, packet_id=7,
+                                dup=True))
+            m = await sub.recv()
+            assert m.payload == b"1"
+            with pytest.raises(asyncio.TimeoutError):
+                await sub.recv(timeout=0.3)
+            await pub.close()
+            await sub.disconnect()
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
