@@ -60,7 +60,7 @@ class DelayedPublish:
             # malformed wrapper: drop (reference logs + drops)
             self.node.metrics.inc("messages.delayed.dropped")
             return ("stop", msg.set_header("allow_publish", False))
-        if self.max_delayed and len(self._heap) >= self.max_delayed:
+        if self.max_delayed and self.count() >= self.max_delayed:
             self.node.metrics.inc("messages.delayed.dropped")
             return ("stop", msg.set_header("allow_publish", False))
         inner = msg.copy()

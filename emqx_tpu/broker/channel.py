@@ -95,6 +95,8 @@ class Channel:
         self.alias_in: dict[int, str] = {}   # v5 inbound topic aliases
         self.connected_at: int = 0
         self.disconnect_reason: Optional[str] = None
+        self._aborted = False     # server-initiated DISCONNECT sent; no
+                                  # further packets may go out (MQTT-3.14)
         self._pendings: list[Message] = []   # deliveries during takeover
         self.mountpoint: Optional[str] = None
 
@@ -110,7 +112,7 @@ class Channel:
                                 f"{name} before CONNECT")
         elif isinstance(pkt, P.Publish):
             m.inc_recv("publish")
-            self._handle_publish(pkt)
+            await self._handle_publish(pkt)
         elif isinstance(pkt, P.Puback):
             m.inc_recv("puback")
             self._handle_puback(pkt)
@@ -125,7 +127,7 @@ class Channel:
             self._handle_pubcomp(pkt)
         elif isinstance(pkt, P.Subscribe):
             m.inc_recv("subscribe")
-            self._handle_subscribe(pkt)
+            await self._handle_subscribe(pkt)
         elif isinstance(pkt, P.Unsubscribe):
             m.inc_recv("unsubscribe")
             self._handle_unsubscribe(pkt)
@@ -142,6 +144,8 @@ class Channel:
             raise ProtocolError(C.RC_PROTOCOL_ERROR, f"unexpected {name}")
 
     def _send(self, pkts: list[P.Packet]) -> None:
+        if self._aborted:
+            return
         for p in pkts:
             self.node.metrics.inc_sent(type(p).__name__.lower())
         self.send(pkts)
@@ -198,7 +202,7 @@ class Channel:
 
         # --- authenticate (hooks chain; default allow)
         self.node.metrics.inc("client.authenticate")
-        auth_result = self.node.hooks.run_fold(
+        auth_result = await self.node.hooks.run_fold_async(
             "client.authenticate", (self.clientinfo,),
             {"ok": True, "password": pkt.password})
         if not (isinstance(auth_result, dict) and auth_result.get("ok")):
@@ -209,7 +213,7 @@ class Channel:
         if isinstance(auth_result, dict):
             self.clientinfo.update(
                 {k: v for k, v in auth_result.items()
-                 if k in ("is_superuser", "mountpoint", "username")})
+                 if k in ("is_superuser", "mountpoint", "username", "acl")})
         self.mountpoint = self.clientinfo.get("mountpoint")
         if self.mountpoint:
             self.mountpoint = T.feed_var(
@@ -275,7 +279,9 @@ class Channel:
         if pkt.proto_ver == C.MQTT_V5:
             ack_props = {
                 "session_expiry_interval": expiry,
-                "receive_maximum": conf.max_inflight,
+                # the broker's own inbound window (zone max_inflight), NOT
+                # the client-RM-capped outbound window
+                "receive_maximum": self.mqtt.get("max_inflight", 32),
                 "maximum_qos": self.mqtt.get("max_qos_allowed", 2),
                 "retain_available": int(self.mqtt.get("retain_available", True)),
                 "maximum_packet_size": self.mqtt.get("max_packet_size"),
@@ -303,8 +309,8 @@ class Channel:
     def _connack_error(self, rc: int) -> None:
         self.node.metrics.inc("packets.connack.error")
         self.node.hooks.run("client.connack", (self.clientinfo, rc))
-        code = rc if self.proto_ver == C.MQTT_V5 else C.rc_to_connack_v3(rc)
-        self._send([P.Connack(session_present=False, reason_code=code)])
+        # always the v5 code here; the serializer downgrades for v3 clients
+        self._send([P.Connack(session_present=False, reason_code=rc)])
         self.close(f"connack_error_0x{rc:02x}")
 
     # ================= PUBLISH =================
@@ -316,7 +322,7 @@ class Channel:
             return topic[len(self.mountpoint):]
         return topic
 
-    def _handle_publish(self, pkt: P.Publish) -> None:
+    async def _handle_publish(self, pkt: P.Publish) -> None:
         topic = pkt.topic
         # v5 topic alias resolution (emqx_channel packet_to_message)
         props = pkt.properties or {}
@@ -339,8 +345,10 @@ class Channel:
             return self._puberr(pkt, C.RC_RETAIN_NOT_SUPPORTED)
 
         # authz (emqx_channel check_pub_authz)
-        if not self._authorize("publish", topic):
+        if not await self._authorize("publish", topic):
             self.node.metrics.inc("packets.publish.auth_error")
+            if self._aborted:       # deny_action=disconnect: no PUBACK after
+                return              # the DISCONNECT went out
             return self._puberr(pkt, C.RC_NOT_AUTHORIZED)
 
         msg = make(self.clientid, pkt.qos, self._mount(topic), pkt.payload,
@@ -389,15 +397,18 @@ class Channel:
             return
         self._send([cls(packet_id=pkt.packet_id, reason_code=code)])
 
-    def _authorize(self, action: str, topic: str) -> bool:
+    async def _authorize(self, action: str, topic: str) -> bool:
         if self.clientinfo.get("is_superuser"):
             return True
         self.node.metrics.inc("client.authorize")
-        res = self.node.hooks.run_fold(
+        res = await self.node.hooks.run_fold_async(
             "client.authorize", (self.clientinfo, action, topic), "allow")
         allowed = res != "deny"
         self.node.metrics.inc(
             "authorization.allow" if allowed else "authorization.deny")
+        if not allowed and self.node.config.get(
+                "authz", "deny_action") == "disconnect":
+            self._disconnect_now(C.RC_NOT_AUTHORIZED)
         return allowed
 
     # ================= acks =================
@@ -442,7 +453,7 @@ class Channel:
             self.node.metrics.inc("packets.pubcomp.missed")
 
     # ================= SUBSCRIBE / UNSUBSCRIBE =================
-    def _handle_subscribe(self, pkt: P.Subscribe) -> None:
+    async def _handle_subscribe(self, pkt: P.Subscribe) -> None:
         import dataclasses
         raw = [(tf, dataclasses.asdict(o) if dataclasses.is_dataclass(o)
                 else dict(o)) for tf, o in pkt.filters]
@@ -453,11 +464,13 @@ class Channel:
         sub_props = pkt.properties or {}
         subid = sub_props.get("subscription_identifier")
         for tf, opts in filters:
-            code = self._do_subscribe(tf, dict(opts), subid)
+            if self._aborted:     # deny_action=disconnect mid-SUBSCRIBE
+                return
+            code = await self._do_subscribe(tf, dict(opts), subid)
             codes.append(code)
         self._send([P.Suback(packet_id=pkt.packet_id, reason_codes=codes)])
 
-    def _do_subscribe(self, tf: str, opts: dict, subid) -> int:
+    async def _do_subscribe(self, tf: str, opts: dict, subid) -> int:
         try:
             real, popts = T.parse(tf, opts)
         except T.TopicError:
@@ -473,7 +486,7 @@ class Channel:
                 return C.RC_SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
             if popts.get("nl"):
                 return C.RC_PROTOCOL_ERROR  # v5: no-local on shared is error
-        if not self._authorize("subscribe", real):
+        if not await self._authorize("subscribe", real):
             self.node.metrics.inc("packets.subscribe.auth_error")
             return C.RC_NOT_AUTHORIZED
         qos = min(int(popts.get("qos", 0)),
@@ -542,8 +555,11 @@ class Channel:
         self.close("disconnect")
 
     def _disconnect_now(self, rc: int, detail: str = "") -> None:
+        if self._aborted:
+            return
         if self.proto_ver == C.MQTT_V5:
             self._send([P.Disconnect(reason_code=rc)])
+        self._aborted = True
         self.disconnect_reason = f"protocol_0x{rc:02x}"
         self.close(detail or f"disconnect_0x{rc:02x}")
 
@@ -667,6 +683,14 @@ class Channel:
                 sess.parked_sid = self.sid
                 self.node.broker.swap_subscriber(
                     self.sid, ParkedSubscriber(sess, self.node))
+                # don't pin this Channel via the bound-method callback:
+                # rebind drop accounting to node-scoped state
+                node, ci = self.node, {"clientid": self.clientid}
+                def _parked_drop(m, r, node=node, ci=ci):
+                    node.metrics.inc("delivery.dropped")
+                    node.metrics.inc(f"delivery.dropped.{r}")
+                    node.hooks.run("delivery.dropped", (ci, m, r))
+                sess.on_dropped = _parked_drop
             else:
                 self.node.broker.subscriber_down(self.sid)
             self.sid = None

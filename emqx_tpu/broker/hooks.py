@@ -16,6 +16,7 @@ Callbacks return:
 from __future__ import annotations
 
 import bisect
+import inspect
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -95,23 +96,45 @@ class Hooks:
             if res == "stop" or (isinstance(res, tuple) and res[:1] == ("stop",)):
                 return
 
+    @staticmethod
+    def _fold_step(res: Any, acc: Any) -> tuple[bool, Any]:
+        """Interpret one callback result → (stop?, new_acc).
+
+        None/'ok' keep acc; 'stop' halts; ('ok'|'stop', acc) thread/halt
+        with a new acc; any bare value becomes the new acc."""
+        if res is None or res == "ok":
+            return False, acc
+        if res == "stop":
+            return True, acc
+        if isinstance(res, tuple) and len(res) == 2:
+            verb, new_acc = res
+            if verb == "ok":
+                return False, new_acc
+            if verb == "stop":
+                return True, new_acc
+        return False, res
+
     def run_fold(self, name: str, args: tuple, acc: Any) -> Any:
         """Parity: emqx_hooks:run_fold/3 — threads acc; ('stop',acc) halts."""
         for cb in self._chains.get(name, ()):
             if cb.filter and not cb.filter(*args, acc):
                 continue
-            res = cb.action(*args, acc)
-            if res is None or res == "ok":
-                continue
-            if res == "stop":
+            stop, acc = self._fold_step(cb.action(*args, acc), acc)
+            if stop:
                 return acc
-            if isinstance(res, tuple) and len(res) == 2:
-                verb, new_acc = res
-                if verb == "ok":
-                    acc = new_acc
-                    continue
-                if verb == "stop":
-                    return new_acc
-            # bare return value → new accumulator (python convenience)
-            acc = res
+        return acc
+
+    async def run_fold_async(self, name: str, args: tuple, acc: Any) -> Any:
+        """run_fold that awaits coroutine callbacks (HTTP authn/authz,
+        exhook gRPC — the reference blocks the channel process for these;
+        here the connection task awaits without blocking the loop)."""
+        for cb in self._chains.get(name, ()):
+            if cb.filter and not cb.filter(*args, acc):
+                continue
+            res = cb.action(*args, acc)
+            if inspect.isawaitable(res):
+                res = await res
+            stop, acc = self._fold_step(res, acc)
+            if stop:
+                return acc
         return acc

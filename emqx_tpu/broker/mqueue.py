@@ -66,6 +66,14 @@ class MQueue:
         self._len += 1
         return dropped
 
+    def insert_front(self, msg: Message) -> None:
+        """Put a message at the head of its priority bucket (used when
+        shrinking the inflight window on resume — these were already
+        inflight, so they precede everything queued later). Never drops."""
+        prio = self._priority(msg.topic)
+        self._qs.setdefault(prio, deque()).appendleft(msg)
+        self._len += 1
+
     def _drop_oldest(self) -> Optional[Message]:
         for prio in sorted(self._qs):
             q = self._qs[prio]

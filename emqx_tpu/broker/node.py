@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Optional
 
+from emqx_tpu.broker.banned import Banned
 from emqx_tpu.broker.cm import ConnectionManager
 from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.broker.message import Message
@@ -42,6 +43,7 @@ class Node:
                                          False))
         self.cm = ConnectionManager()
         self.cm.broker = self.broker
+        self.banned = Banned()
         self.stats.register_stats_fun(self.broker.stats_fun)
         self.stats.register_stats_fun(self.cm.stats_fun)
         self.listeners: list = []
@@ -53,6 +55,7 @@ class Node:
     def sweep(self) -> None:
         """One housekeeping pass; also callable directly from tests."""
         self.cm.sweep_expired_sessions()
+        self.banned.tick()
         self.stats.sample()
         for app in self._apps:
             tick = getattr(app, "tick", None)

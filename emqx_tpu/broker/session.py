@@ -230,9 +230,26 @@ class Session:
         """The old connection hands the session object over wholesale."""
         return self
 
+    def rebalance_inflight(self) -> None:
+        """After the window shrinks on resume (client sent a smaller
+        Receive Maximum), move the newest publish-phase entries back to the
+        front of the mqueue so replay never exceeds the client's RM
+        (MQTT-3.3.4-9). PUBREL-phase entries don't count toward RM."""
+        if not self.inflight.max_size:
+            return
+        pubs = [(pid, e) for pid, e in self.inflight.items()
+                if e.value[0] == "publish"]
+        over = len(pubs) - self.inflight.max_size
+        if over <= 0:
+            return
+        for pid, entry in reversed(pubs[-over:]):
+            self.inflight.delete(pid)
+            self.mqueue.insert_front(entry.value[1])
+
     def replay(self) -> list[tuple[int, str, Message]]:
         """On resume: re-send all inflight (dup) then drain mqueue
         (emqx_session:replay/1)."""
+        self.rebalance_inflight()
         out = []
         for pid, entry in self.inflight.items():
             phase, msg = entry.value

@@ -1,0 +1,376 @@
+"""Tests for authn chain, authz sources, banned table, flapping detect.
+
+Mirrors the reference suites emqx_authn tests, emqx_authz tests,
+emqx_banned_SUITE, emqx_flapping_SUITE, emqx_access_control_SUITE.
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+
+from emqx_tpu.apps.authn import (AuthnChain, BuiltinDB, HTTPAuthenticator,
+                                 JWTAuthenticator)
+from emqx_tpu.apps.authz import (ALLOW, DENY, NOMATCH, Authz, AuthzCache,
+                                 ClientAclSource, FileSource, HTTPSource,
+                                 Rule)
+from emqx_tpu.broker.banned import Banned, FlappingDetect
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client, MqttError
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.utils import passwd as PW
+
+
+def jwt_make(payload: dict, secret: str, alg: str = "HS256") -> str:
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+    head = b64(json.dumps({"alg": alg, "typ": "JWT"}).encode())
+    body = b64(json.dumps(payload).encode())
+    digest = {"HS256": hashlib.sha256, "HS384": hashlib.sha384,
+              "HS512": hashlib.sha512}[alg]
+    sig = b64(hmac.new(secret.encode(), f"{head}.{body}".encode(),
+                       digest).digest())
+    return f"{head}.{body}.{sig}"
+
+
+# ---------- password hashing ----------
+
+class TestPasswd:
+    @pytest.mark.parametrize("algo", ["plain", "md5", "sha", "sha256",
+                                      "sha512", "pbkdf2"])
+    def test_roundtrip(self, algo):
+        h = PW.hash_password(algo, b"secret", "salt123")
+        assert PW.check_password(algo, h, b"secret", "salt123")
+        assert not PW.check_password(algo, h, b"wrong", "salt123")
+        assert not PW.check_password(algo, h, None, "salt123")
+
+    def test_salt_position(self):
+        pre = PW.hash_password("sha256", b"p", "s", "prefix")
+        suf = PW.hash_password("sha256", b"p", "s", "suffix")
+        assert pre != suf
+        assert PW.check_password("sha256", suf, b"p", "s", "suffix")
+
+
+# ---------- builtin DB ----------
+
+class TestBuiltinDB:
+    def test_auth_flow(self):
+        db = BuiltinDB()
+        db.add_user("alice", "pw1", is_superuser=True)
+        v, extra = db.authenticate({"username": "alice"}, b"pw1")
+        assert v == "ok" and extra["is_superuser"]
+        v, _ = db.authenticate({"username": "alice"}, b"bad")
+        assert v == "deny"
+        v, _ = db.authenticate({"username": "nobody"}, b"x")
+        assert v == "ignore"
+
+    def test_clientid_type_and_mgmt(self):
+        db = BuiltinDB(user_id_type="clientid", algorithm="plain")
+        db.add_user("c1", "pw")
+        v, _ = db.authenticate({"clientid": "c1"}, b"pw")
+        assert v == "ok"
+        assert db.update_user("c1", password="pw2")
+        v, _ = db.authenticate({"clientid": "c1"}, b"pw2")
+        assert v == "ok"
+        assert db.delete_user("c1") and not db.delete_user("c1")
+        assert db.list_users() == []
+
+
+# ---------- JWT ----------
+
+class TestJWT:
+    def test_valid_token(self):
+        a = JWTAuthenticator("s3cret")
+        tok = jwt_make({"sub": "x", "exp": time.time() + 60}, "s3cret")
+        v, extra = a.authenticate({"clientid": "c"}, tok.encode())
+        assert v == "ok"
+
+    def test_expired_and_bad_sig(self):
+        a = JWTAuthenticator("s3cret")
+        tok = jwt_make({"exp": time.time() - 10}, "s3cret")
+        assert a.authenticate({}, tok.encode())[0] == "deny"
+        tok2 = jwt_make({"exp": time.time() + 60}, "wrong")
+        assert a.authenticate({}, tok2.encode())[0] == "ignore"
+        assert a.authenticate({}, b"not-a-jwt")[0] == "ignore"
+
+    def test_verify_claims_placeholders(self):
+        a = JWTAuthenticator("k", verify_claims={"username": "%u"})
+        ok = jwt_make({"username": "bob"}, "k")
+        assert a.authenticate({"username": "bob"}, ok.encode())[0] == "ok"
+        assert a.authenticate({"username": "eve"}, ok.encode())[0] == "deny"
+
+    def test_acl_claim(self):
+        a = JWTAuthenticator("k")
+        tok = jwt_make({"acl": {"pub": ["t/%c"], "sub": []}}, "k")
+        v, extra = a.authenticate({"clientid": "c"}, tok.encode())
+        assert v == "ok" and extra["acl"]["pub"] == ["t/%c"]
+
+
+# ---------- chain ----------
+
+class TestAuthnChain:
+    def run_auth(self, node, clientinfo, password):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(node.hooks.run_fold_async(
+                "client.authenticate", (clientinfo,),
+                {"ok": True, "password": password}))
+        finally:
+            loop.close()
+
+    def test_chain_order_and_terminal_deny(self):
+        node = Node()
+        db = BuiltinDB()
+        db.add_user("u", "pw")
+        AuthnChain(node, [db], enable=True).load()
+        assert self.run_auth(node, {"username": "u"}, b"pw")["ok"]
+        res = self.run_auth(node, {"username": "u"}, b"no")
+        assert not res["ok"] and res["rc"] == C.RC_BAD_USER_NAME_OR_PASSWORD
+        # unknown user: all ignore → terminal deny
+        res = self.run_auth(node, {"username": "ghost"}, b"x")
+        assert not res["ok"] and res["rc"] == C.RC_NOT_AUTHORIZED
+
+    def test_disabled_chain_allows(self):
+        node = Node()
+        AuthnChain(node, [], enable=False).load()
+        assert self.run_auth(node, {"username": "any"}, None)["ok"]
+
+    def test_fallthrough_to_second(self):
+        node = Node()
+        db1, db2 = BuiltinDB(), BuiltinDB(user_id_type="clientid")
+        db2.add_user("c9", "pw")
+        AuthnChain(node, [db1, db2], enable=True).load()
+        assert self.run_auth(node, {"clientid": "c9"}, b"pw")["ok"]
+
+
+# ---------- authz rules ----------
+
+CI = {"clientid": "c1", "username": "u1", "peername": ("10.0.0.5", 1234)}
+
+
+class TestAuthzRules:
+    def test_who_forms(self):
+        assert Rule("allow", "all").check(CI, "publish", "t") == ALLOW
+        assert Rule("deny", {"username": "u1"}).check(CI, "publish", "t") == DENY
+        assert Rule("deny", {"username": "zz"}).check(CI, "publish", "t") == NOMATCH
+        assert Rule("allow", {"clientid": "c1"}).check(CI, "subscribe", "t") == ALLOW
+        assert Rule("allow", {"ipaddr": "10.0.0.0/8"}).check(CI, "publish", "t") == ALLOW
+        assert Rule("allow", {"ipaddr": "192.168.0.0/16"}).check(CI, "publish", "t") == NOMATCH
+        assert Rule("allow", {"and": [{"username": "u1"}, {"clientid": "c1"}]}
+                    ).check(CI, "publish", "t") == ALLOW
+        assert Rule("allow", {"or": [{"username": "zz"}, {"clientid": "c1"}]}
+                    ).check(CI, "publish", "t") == ALLOW
+
+    def test_topic_placeholders_and_eq(self):
+        r = Rule("allow", "all", "publish", ["dev/%c/#"])
+        assert r.check(CI, "publish", "dev/c1/x") == ALLOW
+        assert r.check(CI, "publish", "dev/c2/x") == NOMATCH
+        r2 = Rule("allow", "all", "all", [{"eq": "a/+"}])
+        assert r2.check(CI, "publish", "a/+") == ALLOW
+        assert r2.check(CI, "publish", "a/b") == NOMATCH
+
+    def test_action_scope(self):
+        r = Rule("deny", "all", "subscribe", ["#"])
+        assert r.check(CI, "publish", "t") == NOMATCH
+        assert r.check(CI, "subscribe", "t") == DENY
+
+    def test_file_source_order(self):
+        src = FileSource([
+            {"permit": "deny", "who": "all", "action": "subscribe",
+             "topics": ["$SYS/#"]},
+            {"permit": "allow"}])
+        assert src.authorize(CI, "subscribe", "$SYS/brokers") == DENY
+        assert src.authorize(CI, "subscribe", "normal") == ALLOW
+
+    def test_client_acl_source(self):
+        src = ClientAclSource()
+        ci = dict(CI, acl={"pub": ["up/%c"], "sub": ["down/#"]})
+        assert src.authorize(ci, "publish", "up/c1") == ALLOW
+        assert src.authorize(ci, "publish", "down/x") == DENY
+        assert src.authorize(ci, "subscribe", "down/x") == ALLOW
+        assert src.authorize(CI, "publish", "t") == NOMATCH   # no acl claim
+
+
+class TestAuthzApp:
+    def run_authz(self, node, ci, action, topic):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(node.hooks.run_fold_async(
+                "client.authorize", (ci, action, topic), "allow"))
+        finally:
+            loop.close()
+
+    def test_no_match_default(self):
+        node = Node({"authz": {"no_match": "deny"}})
+        Authz(node, [FileSource([{"permit": "allow", "topics": ["ok/#"]}])],
+              cache_enable=False).load()
+        assert self.run_authz(node, CI, "publish", "ok/1") == "allow"
+        assert self.run_authz(node, CI, "publish", "other") == "deny"
+
+    def test_cache_hit(self):
+        node = Node()
+        az = Authz(node, [FileSource([{"permit": "allow"}])]).load()
+        self.run_authz(node, CI, "publish", "t")
+        self.run_authz(node, CI, "publish", "t")
+        assert node.metrics.val("client.authorize.cache_hit") == 1
+        az.drop_cache("c1")
+        self.run_authz(node, CI, "publish", "t")
+        assert node.metrics.val("client.authorize.cache_hit") == 1
+
+    def test_cache_lru_ttl(self):
+        c = AuthzCache(max_size=2, ttl=0.05)
+        c.put(("publish", "a"), "allow")
+        c.put(("publish", "b"), "allow")
+        c.put(("publish", "c"), "allow")   # evicts a
+        assert c.get(("publish", "a")) is None
+        assert c.get(("publish", "c")) == "allow"
+        time.sleep(0.06)
+        assert c.get(("publish", "c")) is None
+
+
+# ---------- banned / flapping ----------
+
+class TestBanned:
+    def test_check_kinds_and_expiry(self):
+        b = Banned()
+        b.create("clientid", "bad")
+        b.create("peerhost", "1.2.3.4", duration=0.05)
+        assert b.check({"clientid": "bad"})
+        assert b.check({"clientid": "x", "peername": ("1.2.3.4", 1)})
+        assert not b.check({"clientid": "good"})
+        time.sleep(0.06)
+        assert not b.check({"clientid": "x", "peername": ("1.2.3.4", 1)})
+        assert b.delete("clientid", "bad")
+        assert not b.check({"clientid": "bad"})
+
+    def test_flapping_bans(self):
+        node = Node({"flapping_detect": {
+            "enable": True, "max_count": 3, "window_time": 10,
+            "ban_time": 60}})
+        FlappingDetect(node).load()
+        for _ in range(3):
+            node.hooks.run("client.disconnected",
+                           ({"clientid": "flappy"}, "closed"))
+        assert node.banned.check({"clientid": "flappy"})
+        assert node.metrics.val("client.flapping.banned") == 1
+
+
+# ---------- end-to-end over sockets ----------
+
+class TestAuthEndToEnd:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_password_auth_and_acl(self, loop):
+        node = Node({"authn": {"enable": True}})
+        db = BuiltinDB()
+        db.add_user("alice", "wonder")
+        AuthnChain(node, [db], enable=True).load()
+        Authz(node, [FileSource([
+            {"permit": "deny", "action": "publish", "topics": ["secret/#"]},
+            {"permit": "allow"}])]).load()
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            # wrong password refused
+            bad = Client(port=lst.port, clientid="c0", username="alice",
+                         password=b"nope")
+            with pytest.raises(MqttError):
+                await bad.connect()
+            # good login
+            c = Client(port=lst.port, clientid="c1", username="alice",
+                       password=b"wonder", proto_ver=C.MQTT_V5)
+            await c.connect()
+            await c.subscribe("secret/x", qos=1)
+            await c.subscribe("open/x", qos=1)
+            ack = await c.publish("secret/x", b"pst", qos=1)
+            assert ack.reason_code == C.RC_NOT_AUTHORIZED
+            await c.publish("open/x", b"hi", qos=1)
+            m = await c.recv()
+            assert m.topic == "open/x"
+            await c.disconnect()
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
+
+    def test_banned_rejected(self, loop):
+        node = Node()
+        node.banned.create("clientid", "evil")
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            c = Client(port=lst.port, clientid="evil", proto_ver=C.MQTT_V5)
+            with pytest.raises(MqttError) as ei:
+                await c.connect()
+            assert f"{C.RC_BANNED}" in str(ei.value)
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
+
+    def test_http_authn_and_authz(self, loop):
+        """Local asyncio HTTP stub server backs both HTTP sources."""
+        seen = []
+
+        async def handler(reader, writer):
+            raw = await reader.read(4096)
+            head, _, body = raw.partition(b"\r\n\r\n")
+            line = head.split(b"\r\n")[0].decode()
+            data = json.loads(body) if body else {}
+            seen.append((line, data))
+            if "/auth" in line:
+                ok = data.get("username") == "hal" and \
+                    data.get("password") == "9000"
+                resp = {"result": "allow" if ok else "deny"}
+            else:   # /acl
+                resp = {"result": "deny"
+                        if data.get("topic", "").startswith("forbidden")
+                        else "allow"}
+            payload = json.dumps(resp).encode()
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-type: application/json"
+                         b"\r\ncontent-length: " + str(len(payload)).encode()
+                         + b"\r\nconnection: close\r\n\r\n" + payload)
+            await writer.drain()
+            writer.close()
+
+        async def go():
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            hport = server.sockets[0].getsockname()[1]
+            node = Node()
+            AuthnChain(node, [HTTPAuthenticator(
+                f"http://127.0.0.1:{hport}/auth")], enable=True).load()
+            Authz(node, [HTTPSource(f"http://127.0.0.1:{hport}/acl")],
+                  cache_enable=False).load()
+            lst = Listener(node, bind="127.0.0.1", port=0)
+            await lst.start()
+            try:
+                c = Client(port=lst.port, clientid="h1", username="hal",
+                           password=b"9000", proto_ver=C.MQTT_V5)
+                await c.connect()
+                ack = await c.publish("forbidden/x", b"x", qos=1)
+                assert ack.reason_code == C.RC_NOT_AUTHORIZED
+                ack = await c.publish("fine/x", b"x", qos=1)
+                assert ack.reason_code in (0, C.RC_NO_MATCHING_SUBSCRIBERS)
+                await c.disconnect()
+                bad = Client(port=lst.port, clientid="h2", username="hal",
+                             password=b"wrong")
+                with pytest.raises(MqttError):
+                    await bad.connect()
+            finally:
+                await lst.stop()
+                server.close()
+                await server.wait_closed()
+            assert any("/auth" in l for l, _ in seen)
+            assert any("/acl" in l for l, _ in seen)
+        loop.run_until_complete(asyncio.wait_for(go(), 20))

@@ -152,3 +152,62 @@ class TestQos2PublishOnReceipt:
             loop.run_until_complete(asyncio.wait_for(go(), 15))
         finally:
             loop.run_until_complete(lst.stop())
+
+
+class TestReplayRebalance:
+    def test_shrunk_window_moves_excess_to_mqueue(self):
+        s = Session("c", SessionConf(max_inflight=10))
+        msgs = [(make("p", 1, f"t/{i}", b"x"), {"qos": 1}) for i in range(5)]
+        s.deliver(msgs)
+        assert len(s.inflight) == 5
+        s.inflight.max_size = 2          # client reconnects with RM=2
+        out = s.replay()
+        pubs = [o for o in out if o[1] == "publish"]
+        assert len(pubs) == 2            # never exceeds the new window
+        assert [m.topic for _, _, m in pubs] == ["t/0", "t/1"]
+        # the moved-back messages kept order at the queue head
+        assert [m.topic for m in s.mqueue.to_list()] == ["t/2", "t/3", "t/4"]
+
+    def test_pubrel_phase_not_counted(self):
+        s = Session("c", SessionConf(max_inflight=5))
+        s.deliver([(make("p", 2, f"q/{i}", b"x"), {"qos": 2})
+                   for i in range(3)])
+        for pid, _ in list(s.inflight.items()):
+            s.pubrec(pid)                 # all move to pubrel phase
+        s.inflight.max_size = 1
+        out = s.replay()
+        assert [phase for _, phase, _ in out].count("pubrel") == 3
+
+
+class TestDenyDisconnect:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_no_packets_after_disconnect(self, loop):
+        from emqx_tpu.apps.authz import Authz, FileSource
+        node = Node({"authz": {"deny_action": "disconnect"}})
+        Authz(node, [FileSource([
+            {"permit": "deny", "topics": ["secret/#"]},
+            {"permit": "allow"}])]).load()
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            c = Client(port=lst.port, clientid="dd", proto_ver=C.MQTT_V5)
+            await c.connect()
+            # SUBSCRIBE [denied, allowed]: server must DISCONNECT and send
+            # nothing after; the allowed filter must not be installed
+            c._send(P.Subscribe(packet_id=1, filters=[
+                ("secret/x", P.SubOpts(qos=1)), ("open/x", P.SubOpts(qos=1))]))
+            await c.closed.wait()
+            assert c.disconnect_pkt is not None
+            assert c.disconnect_pkt.reason_code == C.RC_NOT_AUTHORIZED
+            assert not node.router.has_route("open/x")
+            assert node.metrics.val("packets.suback.sent") == 0
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
