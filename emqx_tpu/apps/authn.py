@@ -131,7 +131,10 @@ class JWTAuthenticator:
                                digest).digest()
             if not _hmac.compare_digest(expect, _b64url_decode(sig_s)):
                 return None
-            return json.loads(_b64url_decode(payload_s))
+            claims = json.loads(_b64url_decode(payload_s))
+            # a validly-signed scalar/array payload is still not a claims
+            # object — treat as unusable, not as a crash
+            return claims if isinstance(claims, dict) else None
         except Exception:
             return None
 
@@ -181,33 +184,20 @@ class HTTPAuthenticator:
         self.timeout = timeout
         self._transport = transport
 
-    def _fill(self, clientinfo: dict, password: Optional[bytes]) -> dict:
+    async def authenticate_async(self, clientinfo: dict,
+                                 password: Optional[bytes]):
+        from emqx_tpu.utils.http import templated_request
+        peer = clientinfo.get("peername")
         subs = {"%u": clientinfo.get("username") or "",
                 "%c": clientinfo.get("clientid") or "",
                 "%P": (password or b"").decode("utf-8", "replace"),
-                "%a": str((clientinfo.get("peername") or ("",))[0]),
-                "%p": str((clientinfo.get("peername") or ("", ""))[1]
-                          if clientinfo.get("peername") else "")}
-        out = {}
-        for k, v in self.body.items():
-            out[k] = subs.get(v, v) if isinstance(v, str) else v
-        return out
-
-    async def authenticate_async(self, clientinfo: dict,
-                                 password: Optional[bytes]):
-        from emqx_tpu.utils import http as H
-        transport = self._transport or H.request
+                "%a": str(peer[0]) if peer else "",
+                "%p": str(peer[1]) if peer else ""}
         try:
-            kwargs = {"headers": self.headers, "timeout": self.timeout}
-            if self.method.lower() == "get":
-                from urllib.parse import urlencode
-                url = self.url + "?" + urlencode(
-                    self._fill(clientinfo, password))
-                resp = await transport("GET", url, **kwargs)
-            else:
-                resp = await transport("POST", self.url,
-                                       json=self._fill(clientinfo, password),
-                                       **kwargs)
+            resp = await templated_request(
+                self.method, self.url, self.body, subs,
+                headers=self.headers, timeout=self.timeout,
+                transport=self._transport)
         except Exception:
             return IGNORE, {}
         if resp.status == 204:

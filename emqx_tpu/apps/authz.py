@@ -139,24 +139,17 @@ class HTTPSource:
 
     async def authorize_async(self, clientinfo: dict, action: str,
                               topic: str) -> str:
-        from emqx_tpu.utils import http as H
-        transport = self._transport or H.request
+        from emqx_tpu.utils.http import templated_request
+        peer = clientinfo.get("peername")
         subs = {"%u": clientinfo.get("username") or "",
                 "%c": clientinfo.get("clientid") or "",
                 "%A": action, "%t": topic,
-                "%a": str((clientinfo.get("peername") or ("",))[0])}
-        payload = {k: subs.get(v, v) if isinstance(v, str) else v
-                   for k, v in self.body.items()}
+                "%a": str(peer[0]) if peer else ""}
         try:
-            if self.method.lower() == "get":
-                from urllib.parse import urlencode
-                resp = await transport(
-                    "GET", self.url + "?" + urlencode(payload),
-                    headers=self.headers, timeout=self.timeout)
-            else:
-                resp = await transport("POST", self.url, json=payload,
-                                       headers=self.headers,
-                                       timeout=self.timeout)
+            resp = await templated_request(
+                self.method, self.url, self.body, subs,
+                headers=self.headers, timeout=self.timeout,
+                transport=self._transport)
         except Exception:
             return NOMATCH
         if resp.status == 204:
@@ -217,10 +210,18 @@ class Authz:
     def load(self) -> "Authz":
         self.node.hooks.add("client.authorize", self.on_authorize,
                             priority=HP_AUTHZ, tag="authz")
+        # drain the per-client cache when its channel goes away, else the
+        # cache dict grows one entry per clientid ever seen
+        self.node.hooks.add("client.disconnected", self._on_disconnected,
+                            tag="authz")
         return self
 
     def unload(self) -> None:
         self.node.hooks.delete("client.authorize", "authz")
+        self.node.hooks.delete("client.disconnected", "authz")
+
+    def _on_disconnected(self, clientinfo: dict, reason) -> None:
+        self.drop_cache(clientinfo.get("clientid", ""))
 
     def add_source(self, s, front: bool = False) -> None:
         if front:

@@ -1,0 +1,89 @@
+"""$SYS broker: periodic heartbeat/stats/metrics publishes + alarm topics.
+
+Parity: apps/emqx/src/emqx_sys.erl — `$SYS/brokers` node list,
+`$SYS/brokers/<node>/{version,uptime,datetime,sysdescr}` heartbeats
+(emqx_sys.erl:56-67,83-91), `$SYS/brokers/<node>/stats/<name>` and
+`.../metrics/<name>` interval publishes; alarm transitions republished on
+`$SYS/brokers/<node>/alarms/{activate,deactivate}` (emqx_alarm handler).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from emqx_tpu.broker.message import make
+from emqx_tpu.version import __version__
+
+
+class SysBroker:
+    def __init__(self, node, conf: Optional[dict] = None):
+        self.node = node
+        c = dict(node.config.get("broker") or {})
+        c.update(conf or {})
+        self.heartbeat_interval = float(c.get("sys_heartbeat_interval", 30))
+        self.msg_interval = float(c.get("sys_msg_interval", 60))
+        self.started_at = time.monotonic()
+        self._last_heartbeat = 0.0
+        self._last_msg = 0.0
+
+    def load(self) -> "SysBroker":
+        self.node.hooks.add("alarm.activated", self.on_alarm_activated,
+                            tag="sys")
+        self.node.hooks.add("alarm.deactivated", self.on_alarm_deactivated,
+                            tag="sys")
+        return self
+
+    def unload(self) -> None:
+        self.node.hooks.delete("alarm.activated", "sys")
+        self.node.hooks.delete("alarm.deactivated", "sys")
+
+    # ---- publishing ----
+    def _pub(self, suffix: str, payload: bytes) -> None:
+        self.node.broker.publish(make(
+            "", 0, f"$SYS/brokers/{self.node.name}/{suffix}", payload,
+            flags={"sys": True}))
+
+    def uptime(self) -> float:
+        return time.monotonic() - self.started_at
+
+    def publish_heartbeat(self) -> None:
+        self.node.broker.publish(make(
+            "", 0, "$SYS/brokers", self.node.name.encode(),
+            flags={"sys": True}))
+        self._pub("version", __version__.encode())
+        self._pub("uptime", str(int(self.uptime())).encode())
+        self._pub("datetime",
+                  time.strftime("%Y-%m-%d %H:%M:%S").encode())
+        self._pub("sysdescr", b"emqx_tpu broker")
+
+    def publish_stats_metrics(self) -> None:
+        for name, val in self.node.stats.sample().items():
+            self._pub(f"stats/{name}", str(val).encode())
+        for name, val in self.node.metrics.all().items():
+            self._pub(f"metrics/{name}", str(val).encode())
+
+    # ---- alarms → $SYS ----
+    def on_alarm_activated(self, alarm: dict) -> None:
+        self._pub("alarms/activate", json.dumps(alarm).encode())
+
+    def on_alarm_deactivated(self, alarm: dict) -> None:
+        self._pub("alarms/deactivate", json.dumps(alarm).encode())
+
+    # ---- timer (Node.sweep) ----
+    def tick(self) -> None:
+        now = time.monotonic()
+        if now - self._last_heartbeat >= self.heartbeat_interval:
+            self._last_heartbeat = now
+            self.publish_heartbeat()
+        if now - self._last_msg >= self.msg_interval:
+            self._last_msg = now
+            self.publish_stats_metrics()
+
+    def info(self) -> dict:
+        """emqx_mgmt broker info surface."""
+        return {"node": self.node.name, "version": __version__,
+                "uptime": int(self.uptime()),
+                "datetime": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "sysdescr": "emqx_tpu broker"}

@@ -119,3 +119,13 @@ class FlappingDetect:
                 reason=f"flapping: {self.max_count} disconnects in "
                        f"{self.window}s", duration=self.ban_time)
             self.node.metrics.inc("client.flapping.banned")
+
+    def tick(self) -> None:
+        """Housekeeping: drop clientids whose newest disconnect left the
+        window — otherwise one timestamp list leaks per clientid ever
+        disconnected."""
+        cutoff = time.monotonic() - self.window
+        stale = [cid for cid, hits in self._hits.items()
+                 if not hits or hits[-1] < cutoff]
+        for cid in stale:
+            del self._hits[cid]

@@ -84,6 +84,10 @@ class Channel:
         self.conn_state = CONN_IDLE
         self.zone = conninfo.get("zone")
         self.mqtt = node.config.mqtt(self.zone)
+        from emqx_tpu.broker.limiter import QuotaLimiter
+        self.quota = QuotaLimiter(
+            (node.config.get_zone(self.zone, "rate_limit") or {})
+            .get("quota_messages_routing") or None)
 
         self.proto_ver = C.MQTT_V4
         self.clientinfo: dict = {}
@@ -343,6 +347,11 @@ class Channel:
             return self._puberr(pkt, C.RC_QOS_NOT_SUPPORTED)
         if pkt.retain and not self.mqtt.get("retain_available", True):
             return self._puberr(pkt, C.RC_RETAIN_NOT_SUPPORTED)
+
+        # quota (emqx_channel process_publish pipeline: check_quota first)
+        if not self.quota.check_publish():
+            self.node.metrics.inc("packets.publish.quota_exceeded")
+            return self._puberr(pkt, C.RC_QUOTA_EXCEEDED)
 
         # authz (emqx_channel check_pub_authz)
         if not await self._authorize("publish", topic):

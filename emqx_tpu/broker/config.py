@@ -72,7 +72,14 @@ DEFAULTS: dict = {
         "enable": False, "max_count": 15, "window_time": 60,
         "ban_time": 300,
     },
-    "force_shutdown": {"max_mqueue_len": 10000},
+    "force_shutdown": {"max_mqueue_len": 10000, "max_awaiting_rel": 0},
+    "rate_limit": {
+        "max_conn_rate": 0,          # new connections/sec per listener
+        "conn_messages_in": 0,       # packets/sec per connection
+        "conn_bytes_in": 0,          # bytes/sec per connection
+        "quota_messages_routing": 0,  # publishes/sec per connection
+    },
+    "alarm": {"size_limit": 1000, "validity_period": 86400},
     "sysmon": {"os": {"sysmem_high_watermark": 0.7,
                       "procmem_high_watermark": 0.05}},
     "rule_engine": {"rules": []},

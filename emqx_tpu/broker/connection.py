@@ -17,6 +17,8 @@ import time
 from typing import Optional
 
 from emqx_tpu.broker.channel import Channel, ProtocolError
+from emqx_tpu.broker.limiter import (ConnectionLimiter, ForceShutdownPolicy,
+                                     TokenBucket)
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import packet as P
 from emqx_tpu.mqtt.frame import FrameError, FrameParser, serialize
@@ -43,6 +45,13 @@ class Connection:
         self.last_rx = time.monotonic()
         self._closing: Optional[str] = None
         self._timer_task: Optional[asyncio.Task] = None
+        rl = node.config.get_zone(zone, "rate_limit") or {}
+        self.limiter = ConnectionLimiter(
+            rl.get("conn_messages_in") or None,
+            rl.get("conn_bytes_in") or None)
+        fs = node.config.get_zone(zone, "force_shutdown") or {}
+        self.force_shutdown = ForceShutdownPolicy(
+            fs.get("max_mqueue_len", 0), fs.get("max_awaiting_rel", 0))
 
     # ---- outbound ----
     def _send_packets(self, pkts: list[P.Packet]) -> None:
@@ -94,6 +103,13 @@ class Connection:
                         break
                 if pkts:
                     await self._drain()
+                    # ingress rate limit: a depleted bucket pauses reading
+                    # (the {active,N}-off backpressure, emqx_connection
+                    # ensure_rate_limit)
+                    pause = self.limiter.check(len(pkts), len(data))
+                    if pause > 0:
+                        self.node.metrics.inc("connection.rate_limited")
+                        await asyncio.sleep(pause)
             reason = self._closing or reason
         except (ConnectionResetError, BrokenPipeError):
             reason = "closed"
@@ -152,6 +168,11 @@ class Connection:
             if retry_iv and now - last_retry >= retry_iv:
                 last_retry = now
                 self.channel.retry_deliveries()
+            why = self.force_shutdown.violated(self.channel.session)
+            if why is not None:
+                self.node.metrics.inc("connection.force_shutdown")
+                self._request_close(f"force_shutdown:{why}")
+                return
 
 
 class Listener:
@@ -169,10 +190,19 @@ class Listener:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.Task] = set()
         self.current_conns = 0
+        rate = (node.config.get_zone(zone, "rate_limit") or {}) \
+            .get("max_conn_rate", 0)
+        self._accept_bucket = TokenBucket(rate) if rate else None
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
         if self.current_conns >= self.max_connections:
+            writer.close()
+            return
+        if self._accept_bucket is not None \
+                and self._accept_bucket.consume() > 0:
+            # accept-rate limit: drop the connection (esockd max_conn_rate)
+            self.node.metrics.inc("connection.accept_limited")
             writer.close()
             return
         self.current_conns += 1

@@ -12,8 +12,10 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Optional
 
+from emqx_tpu.broker.alarm import AlarmManager
 from emqx_tpu.broker.banned import Banned
 from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.monitor import OsMon
 from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.metrics import Metrics, Stats
@@ -44,6 +46,12 @@ class Node:
         self.cm = ConnectionManager()
         self.cm.broker = self.broker
         self.banned = Banned()
+        aconf = self.config.get("alarm") or {}
+        self.alarms = AlarmManager(
+            self.hooks, size_limit=aconf.get("size_limit", 1000),
+            validity_period=aconf.get("validity_period", 86400))
+        self.os_mon = OsMon(self.alarms,
+                            self.config.get("sysmon", "os") or {})
         self.stats.register_stats_fun(self.broker.stats_fun)
         self.stats.register_stats_fun(self.cm.stats_fun)
         self.listeners: list = []
@@ -56,6 +64,8 @@ class Node:
         """One housekeeping pass; also callable directly from tests."""
         self.cm.sweep_expired_sessions()
         self.banned.tick()
+        self.alarms.tick()
+        self.os_mon.tick()
         self.stats.sample()
         for app in self._apps:
             tick = getattr(app, "tick", None)

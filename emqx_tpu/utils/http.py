@@ -75,6 +75,26 @@ async def request(method: str, url: str, *,
     return HttpResponse(status, rhdrs, rest)
 
 
+async def templated_request(method: str, url: str, body_template: dict,
+                            subs: dict, *, headers: Optional[dict] = None,
+                            timeout: float = 5.0,
+                            transport=None) -> HttpResponse:
+    """Fill %-placeholders in a body template and issue the request —
+    GET encodes the body as a query string, everything else POSTs JSON.
+    Shared by the HTTP authenticator and the HTTP ACL source (the
+    reference's emqx_authn_http / emqx_authz_http both do exactly this
+    placeholder-fill + request step)."""
+    transport = transport or request
+    payload = {k: subs.get(v, v) if isinstance(v, str) else v
+               for k, v in body_template.items()}
+    if method.lower() == "get":
+        from urllib.parse import urlencode
+        return await transport("GET", url + "?" + urlencode(payload),
+                               headers=headers, timeout=timeout)
+    return await transport("POST", url, json=payload, headers=headers,
+                           timeout=timeout)
+
+
 def _dechunk(data: bytes) -> bytes:
     out = bytearray()
     while data:

@@ -1,0 +1,237 @@
+"""Tests: alarms, $SYS broker, OS monitors, tracer, rate limiters.
+
+Mirrors the reference suites emqx_alarm_SUITE, emqx_sys_SUITE,
+emqx_os_mon_SUITE, emqx_tracer_SUITE and the limiter/force_shutdown
+coverage in emqx_connection_SUITE.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.apps.sys import SysBroker
+from emqx_tpu.apps.tracer import Tracer
+from emqx_tpu.broker.alarm import AlarmManager
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.limiter import (ConnectionLimiter, ForceShutdownPolicy,
+                                     QuotaLimiter, TokenBucket)
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.monitor import OsMon, cpu_load, proc_memory, sys_memory
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.mqtt import constants as C
+
+
+class Sink:
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, f, m):
+        self.got.append((f, m))
+        return True
+
+
+# ---------- alarms ----------
+
+class TestAlarms:
+    def test_lifecycle_and_hooks(self):
+        h = Hooks()
+        seen = []
+        h.add("alarm.activated", lambda a: seen.append(("on", a["name"])))
+        h.add("alarm.deactivated", lambda a: seen.append(("off", a["name"])))
+        am = AlarmManager(h)
+        assert am.activate("overload", {"v": 1}, "too hot")
+        assert not am.activate("overload")       # already active
+        assert am.is_active("overload")
+        assert len(am.get_alarms("activated")) == 1
+        assert am.deactivate("overload")
+        assert not am.deactivate("overload")
+        assert len(am.get_alarms("deactivated")) == 1
+        assert seen == [("on", "overload"), ("off", "overload")]
+
+    def test_history_cap_and_expiry(self):
+        am = AlarmManager(None, size_limit=2, validity_period=0.05)
+        for i in range(4):
+            am.activate(f"a{i}")
+            am.deactivate(f"a{i}")
+        assert len(am.get_alarms("deactivated")) == 2
+        time.sleep(0.06)
+        am.tick()
+        assert am.get_alarms("deactivated") == []
+
+    def test_ensure_edge_trigger(self):
+        am = AlarmManager(None)
+        am.ensure("x", True)
+        am.ensure("x", True)
+        assert len(am.get_alarms("activated")) == 1
+        am.ensure("x", False)
+        assert not am.is_active("x")
+
+
+# ---------- monitors ----------
+
+class TestOsMon:
+    def test_readings(self):
+        used, total = sys_memory()
+        assert total > 0 and 0 < used <= total
+        assert proc_memory() > 0
+        assert cpu_load() >= 0
+
+    def test_watermark_alarm(self):
+        am = AlarmManager(None)
+        mon = OsMon(am, {"sysmem_high_watermark": 0.0000001,
+                         "procmem_high_watermark": 2.0})
+        mon.tick()
+        assert am.is_active("high_system_memory_usage")
+        assert not am.is_active("high_process_memory_usage")
+        mon.sysmem_high = 2.0
+        mon.tick()
+        assert not am.is_active("high_system_memory_usage")
+
+
+# ---------- $SYS ----------
+
+class TestSysBroker:
+    def test_heartbeat_and_stats_topics(self):
+        node = Node({"broker": {"sys_heartbeat_interval": 0,
+                                "sys_msg_interval": 0}})
+        sys_app = node.register_app(SysBroker(node).load())
+        sink = Sink()
+        sid = node.broker.register(sink, "w")
+        node.broker.subscribe(sid, "$SYS/#")
+        sys_app.publish_heartbeat()
+        topics = [m.topic for _, m in sink.got]
+        assert "$SYS/brokers" in topics
+        assert f"$SYS/brokers/{node.name}/version" in topics
+        assert f"$SYS/brokers/{node.name}/uptime" in topics
+        sink.got.clear()
+        sys_app.publish_stats_metrics()
+        topics = [m.topic for _, m in sink.got]
+        assert any("/stats/connections.count" in t for t in topics)
+        assert any("/metrics/messages.publish" in t for t in topics)
+
+    def test_alarm_republish(self):
+        node = Node()
+        node.register_app(SysBroker(node).load())
+        sink = Sink()
+        sid = node.broker.register(sink, "w")
+        node.broker.subscribe(sid, "$SYS/brokers/+/alarms/#")
+        node.alarms.activate("boom", {}, "kapow")
+        assert sink.got and sink.got[-1][1].topic.endswith("alarms/activate")
+        node.alarms.deactivate("boom")
+        assert sink.got[-1][1].topic.endswith("alarms/deactivate")
+
+
+# ---------- tracer ----------
+
+class TestTracer:
+    def test_trace_clientid_and_topic(self, tmp_path):
+        node = Node()
+        tr = node.register_app(Tracer(node).load())
+        f1 = tmp_path / "c1.log"
+        f2 = tmp_path / "top.log"
+        assert tr.start_trace("clientid", "c1", str(f1))
+        assert not tr.start_trace("clientid", "c1", str(f1))
+        assert tr.start_trace("topic", "t/#", str(f2))
+        assert len(tr.lookup_traces()) == 2
+        node.hooks.run("client.connected", ({"clientid": "c1"}, {}))
+        node.broker.publish(make("c1", 1, "x/y", b"payload1"))
+        node.broker.publish(make("other", 0, "t/1", b"payload2"))
+        node.broker.publish(make("other", 0, "nope", b"payload3"))
+        text1 = f1.read_text()
+        assert "CONNECTED clientid=c1" in text1
+        assert "topic=x/y" in text1
+        text2 = f2.read_text()
+        assert "topic=t/1" in text2 and "payload3" not in text2
+        assert tr.stop_trace("topic", "t/#")
+        assert not tr.stop_trace("topic", "t/#")
+        assert len(tr.lookup_traces()) == 1
+
+
+# ---------- limiters ----------
+
+class TestTokenBucket:
+    def test_burst_then_pace(self):
+        tb = TokenBucket(rate=10, burst=5)
+        now = time.monotonic()
+        assert all(tb.consume(1, now) == 0 for _ in range(5))
+        pause = tb.consume(1, now)
+        assert pause > 0
+        assert tb.consume(1, now + pause + 1e-6) == 0
+
+    def test_quota(self):
+        q = QuotaLimiter(rate=2, burst=2)
+        assert q.check_publish() and q.check_publish()
+        assert not q.check_publish()
+        assert QuotaLimiter(None).check_publish()
+
+    def test_conn_limiter(self):
+        cl = ConnectionLimiter(msgs_rate=1, bytes_rate=None)
+        assert cl.check(1, 100) == 0
+        assert cl.check(1, 100) > 0
+        assert ConnectionLimiter().check(1000, 10**9) == 0
+
+    def test_force_shutdown(self):
+        from emqx_tpu.broker.session import Session, SessionConf
+        from emqx_tpu.broker.mqueue import MQueueOpts
+        pol = ForceShutdownPolicy(max_mqueue_len=2)
+        s = Session("c", SessionConf(max_inflight=1,
+                                     mqueue=MQueueOpts(max_len=100)))
+        assert pol.violated(s) is None
+        s.deliver([(make("p", 1, "t", b"x"), {"qos": 1}) for _ in range(5)])
+        assert pol.violated(s) == "mqueue_overflow"
+        assert pol.violated(None) is None
+
+
+class TestLimiterEndToEnd:
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_quota_exceeded_rc(self, loop):
+        node = Node({"rate_limit": {"quota_messages_routing": 2}})
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            c = Client(port=lst.port, clientid="q", proto_ver=C.MQTT_V5)
+            await c.connect()
+            rcs = []
+            for i in range(4):
+                ack = await c.publish("t", b"x", qos=1)
+                rcs.append(ack.reason_code)
+            assert C.RC_QUOTA_EXCEEDED in rcs
+            assert rcs[0] != C.RC_QUOTA_EXCEEDED
+            await c.disconnect()
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
+
+    def test_force_shutdown_kills_connection(self, loop):
+        node = Node({"force_shutdown": {"max_mqueue_len": 3},
+                     "mqtt": {"max_inflight": 1, "max_mqueue_len": 100}})
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        async def go():
+            slow = Client(port=lst.port, clientid="slow")
+            slow.auto_ack = False        # never acks → inflight stays full
+            await slow.connect()
+            await slow.subscribe("f/t", qos=1)
+            pub = Client(port=lst.port, clientid="pub")
+            await pub.connect()
+            for i in range(8):
+                await pub.publish("f/t", b"x", qos=1)
+            # timer tick (1s) must detect the overflow and kill `slow`
+            await asyncio.wait_for(slow.closed.wait(), 5)
+            assert node.metrics.val("connection.force_shutdown") == 1
+            await pub.disconnect()
+        try:
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(lst.stop())
