@@ -30,6 +30,7 @@ class ConnectionManager:
         self._detached: dict[str, Session] = {}
         self._parked_at: dict[str, float] = {}
         self.broker = None      # wired by Node for parked-session cleanup
+        self.cluster = None     # wired by ClusterNode (registry + takeover)
         self.max_count = 0
 
     # ---- registry (emqx_cm:register_channel/3 :124-131) ----
@@ -38,11 +39,15 @@ class ConnectionManager:
         self._channels[clientid] = channel
         self._info[clientid] = info or {}
         self.max_count = max(self.max_count, len(self._channels))
+        if self.cluster:
+            self.cluster.registry_register(clientid)
 
     def unregister_channel(self, clientid: str, channel: Any = None) -> None:
         if channel is None or self._channels.get(clientid) is channel:
             self._channels.pop(clientid, None)
             self._info.pop(clientid, None)
+            if self.cluster:
+                self.cluster.registry_unregister(clientid)
 
     def lookup_channel(self, clientid: str) -> Optional[Any]:
         return self._channels.get(clientid)
@@ -69,9 +74,13 @@ class ConnectionManager:
                            new_channel: Any) -> tuple[Session, bool]:
         """Returns (session, session_present). Serialized per clientid
         (the emqx_cm_locker analog)."""
-        async with self._lock(clientid):
+        lock = (self.cluster.lock(clientid) if self.cluster
+                else self._lock(clientid))
+        async with lock:
             if clean_start:
                 await self.discard_session(clientid)
+                if self.cluster:
+                    await self.cluster.discard_remote(clientid)
                 return Session(clientid, conf), False
             # try takeover from a live channel first
             old = self._channels.get(clientid)
@@ -93,6 +102,13 @@ class ConnectionManager:
             if detached is not None:
                 detached.conf = conf
                 return detached, True
+            if self.cluster:
+                # the client may live on another node (emqx_cm:268-298
+                # rpc takeover via the cm registry)
+                wire = await self.cluster.takeover_remote(clientid)
+                if wire is not None:
+                    session = Session.from_wire(wire, conf)
+                    return session, True
             return Session(clientid, conf), False
 
     async def discard_session(self, clientid: str) -> None:
@@ -123,18 +139,26 @@ class ConnectionManager:
     def park_session(self, clientid: str, session: Session) -> None:
         """Hold a session whose connection closed with expiry > 0; its
         broker subscriptions stay live (sid re-pointed by the channel) so
-        offline messages keep enqueueing."""
+        offline messages keep enqueueing. The clientid stays in the cluster
+        registry so a reconnect on another node can find and take it over
+        (emqx_cm_registry keeps entries for disconnected persistent
+        sessions too)."""
         import time
         self._detached[clientid] = session
         self._parked_at[clientid] = time.monotonic()
+        if self.cluster:
+            self.cluster.registry_register(clientid)
 
     def drop_parked(self, clientid: str) -> None:
         sess = self._detached.pop(clientid, None)
         self._parked_at.pop(clientid, None)
-        if sess is not None and self.broker is not None:
-            sid = getattr(sess, "parked_sid", None)
-            if sid is not None:
-                self.broker.subscriber_down(sid)
+        if sess is not None:
+            if self.broker is not None:
+                sid = getattr(sess, "parked_sid", None)
+                if sid is not None:
+                    self.broker.subscriber_down(sid)
+            if self.cluster:
+                self.cluster.registry_unregister(clientid)
 
     def sweep_expired_sessions(self) -> int:
         """Expire parked sessions past their session_expiry_interval

@@ -151,6 +151,29 @@ class Message:
             "timestamp": self.ts, "retain": self.retain,
         }
 
+    def to_wire(self) -> dict:
+        """Full-fidelity encoding for cross-node forwarding (the gen_rpc
+        #delivery{} payload). Non-serializable header values (live objects
+        planted by local hooks) are dropped — they are node-local by nature."""
+        def safe(v):
+            return isinstance(v, (str, int, float, bool, bytes, type(None))) \
+                or (isinstance(v, (list, tuple)) and all(safe(x) for x in v)) \
+                or (isinstance(v, dict)
+                    and all(isinstance(k, str) and safe(x)
+                            for k, x in v.items()))
+        return {"topic": self.topic, "payload": self.payload,
+                "qos": self.qos, "from": self.from_,
+                "flags": dict(self.flags),
+                "headers": {k: v for k, v in self.headers.items()
+                            if safe(v)},
+                "msgid": self.id, "ts": self.ts}
+
+    @staticmethod
+    def from_wire(d: dict) -> "Message":
+        return Message(topic=d["topic"], payload=d["payload"], qos=d["qos"],
+                       from_=d["from"], flags=dict(d["flags"]),
+                       headers=dict(d["headers"]), id=d["msgid"], ts=d["ts"])
+
 
 def make(from_: str, qos: int, topic: str, payload: bytes,
          flags: Optional[dict] = None, headers: Optional[dict] = None) -> Message:

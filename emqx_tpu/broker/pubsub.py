@@ -56,6 +56,10 @@ class Broker:
         self.shared_strategy = shared_strategy
         self.shared_dispatch_ack = shared_dispatch_ack
 
+        # set by cluster.ClusterNode when this broker joins a cluster:
+        # replicates routes/shared-members and forwards cross-node
+        self.cluster = None
+
         self._subscribers: dict[int, Subscriber] = {}
         self._sub_meta: dict[int, str] = {}     # sid -> clientid
         # filter -> {sid -> subopts}  (emqx_subscriber + emqx_suboption)
@@ -95,11 +99,15 @@ class Broker:
             g.members[sid] = opts
             if len(g.members) == 1:
                 self.router.add_route(real)
+            if self.cluster:
+                self.cluster.shared_join(real, group, sid)
         else:
             fsubs = self.subs.setdefault(real, {})
             fsubs[sid] = opts
             if len(fsubs) == 1:
                 self.router.add_route(real)
+                if self.cluster:
+                    self.cluster.local_route_add(real)
 
     def unsubscribe(self, sid: int, topic_filter: str) -> bool:
         real, opts = T.parse(topic_filter)
@@ -112,12 +120,14 @@ class Broker:
             del g.members[sid]
             if g.sticky == sid:
                 g.sticky = None
+            if self.cluster:
+                self.cluster.shared_leave(real, group, sid)
             if not g.members:
                 del groups[group]
                 if not groups:
                     del self.shared[real]
                 if not self._has_any_sub(real):
-                    self.router.delete_route(real)
+                    self._route_del(real)
             return True
         fsubs = self.subs.get(real)
         if not fsubs or sid not in fsubs:
@@ -126,8 +136,17 @@ class Broker:
         if not fsubs:
             del self.subs[real]
             if not self._has_any_sub(real):
-                self.router.delete_route(real)
+                self._route_del(real)
         return True
+
+    def _route_del(self, real: str) -> None:
+        """Remove the local route; under a cluster the filter stays in the
+        local trie while any remote node still routes it (the reference's
+        per-node #route rows — emqx_router.erl:77-86)."""
+        if self.cluster:
+            self.cluster.local_route_del(real)
+        else:
+            self.router.delete_route(real)
 
     def _has_any_sub(self, real: str) -> bool:
         if self.subs.get(real):
@@ -181,6 +200,8 @@ class Broker:
         for f in filters:
             n += self.dispatch(f, msg)
         n += self._dispatch_shared(msg, filters)
+        if self.cluster:
+            n += self.cluster.forward(msg, filters)
         if n == 0 and not msg.is_sys:
             self.metrics.inc("messages.dropped")
             self.metrics.inc("messages.dropped.no_subscribers")
@@ -210,6 +231,8 @@ class Broker:
 
     # ---- shared dispatch (emqx_shared_sub:dispatch :120-135) ----
     def _dispatch_shared(self, msg: Message, filters: list[str]) -> int:
+        if self.cluster:
+            return self.cluster.dispatch_shared(self, msg, filters)
         n = 0
         for real in filters:
             for group, g in list(self.shared.get(real, {}).items()):

@@ -264,6 +264,40 @@ class Session:
     def clear_expired(self) -> int:
         return self.mqueue.filter(lambda m: not m.is_expired())
 
+    # ---- cross-node takeover serialization (the reference moves the live
+    # session term over disterl, emqx_cm.erl:268-298; we move a wire map
+    # over the rpc plane) ----
+    def to_wire(self) -> dict:
+        return {
+            "clientid": self.clientid,
+            "subscriptions": dict(self.subscriptions),
+            "awaiting_rel": dict(self.awaiting_rel),
+            "next_pkt_id": self.next_pkt_id,
+            "created_at": self.created_at,
+            "expiry_interval": self.conf.session_expiry_interval,
+            # both phases hold the Message (pubrec keeps it for PUBCOMP)
+            "inflight": [[pid, e.value[0], e.value[1].to_wire()]
+                         for pid, e in self.inflight.items()],
+            "mqueue": [m.to_wire() for m in self.mqueue.to_list()],
+        }
+
+    @staticmethod
+    def from_wire(d: dict, conf: Optional[SessionConf] = None) -> "Session":
+        s = Session(d["clientid"], conf)
+        s.conf.session_expiry_interval = d.get(
+            "expiry_interval", s.conf.session_expiry_interval)
+        s.subscriptions = {str(k): dict(v)
+                           for k, v in d["subscriptions"].items()}
+        s.awaiting_rel = {int(k): int(v)
+                          for k, v in d["awaiting_rel"].items()}
+        s.next_pkt_id = d["next_pkt_id"]
+        s.created_at = d["created_at"]
+        for pid, phase, val in d["inflight"]:
+            s.inflight.insert(int(pid), (phase, Message.from_wire(val)))
+        for m in d["mqueue"]:
+            s.mqueue.insert(Message.from_wire(m))
+        return s
+
     def info(self) -> dict:
         return {
             "clientid": self.clientid,

@@ -1,0 +1,219 @@
+"""Replicated metadata store: the ekka_mnesia analog.
+
+Parity: the reference replicates routes/shared-subs/banned/etc. as mnesia
+ram_copies tables with transactional writes (emqx_router.erl:77-86,
+emqx_shared_sub.erl:89-97). SURVEY.md §7 re-derives this as a simpler,
+stronger design: **each node is the single writer for its own entries**, and
+publishes an ordered per-origin op log; every node applies every origin's log
+in order, so all replicas converge without distributed transactions (the
+reference's route-lock strategies emqx_router.erl:251-303 exist only because
+multiple nodes mutate shared trie rows — here they never do).
+
+Tables are bags keyed by (key, origin): an origin can only add/delete values
+it owns, which makes nodedown cleanup (`purge_origin`, the emqx_router_helper
+analog) exact. Late joiners get a full snapshot, then the live feed; a
+per-origin sequence number discards out-of-order/duplicate casts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Optional
+
+from emqx_tpu.cluster.membership import Membership
+from emqx_tpu.cluster.rpc import RpcNode
+
+log = logging.getLogger("emqx_tpu.cluster.store")
+
+
+class Table:
+    """One replicated bag table: key -> {origin -> [values]}."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.rows: dict[Any, dict[str, list]] = {}
+        # fn(op, key, value, origin) on every applied mutation
+        self.watchers: list[Callable[[str, Any, Any, str], None]] = []
+
+    def _apply(self, op: str, key: Any, value: Any, origin: str) -> None:
+        if op == "add":
+            vals = self.rows.setdefault(key, {}).setdefault(origin, [])
+            if value not in vals:
+                vals.append(value)
+        elif op == "del":
+            per = self.rows.get(key)
+            if per is None:
+                return
+            vals = per.get(origin)
+            if vals is None:
+                return
+            try:
+                vals.remove(value)
+            except ValueError:
+                return
+            if not vals:
+                del per[origin]
+            if not per:
+                del self.rows[key]
+        for w in self.watchers:
+            try:
+                w(op, key, value, origin)
+            except Exception:  # noqa: BLE001
+                log.exception("table %s watcher failed", self.name)
+
+    # ---- reads (always local; ram_copies semantics) ----
+    def lookup(self, key: Any) -> list[tuple[str, Any]]:
+        """[(origin, value)] for key."""
+        return [(o, v) for o, vals in self.rows.get(key, {}).items()
+                for v in vals]
+
+    def origins(self, key: Any) -> list[str]:
+        return list(self.rows.get(key, {}))
+
+    def keys(self) -> list:
+        return list(self.rows)
+
+    def count(self) -> int:
+        return sum(len(vals) for per in self.rows.values()
+                   for vals in per.values())
+
+
+class ClusterStore:
+    def __init__(self, rpc: RpcNode, membership: Membership):
+        self.rpc = rpc
+        self.membership = membership
+        self.tables: dict[str, Table] = {}
+        self._seq = 0                         # ops this origin has published
+        self._applied: dict[str, int] = {}    # origin -> last applied seq
+        self._buffer: dict[str, dict[int, tuple]] = {}  # out-of-order holds
+        self._lag_seen: dict[str, int] = {}   # origin -> applied at last check
+        self._ae_task: Optional[asyncio.Task] = None
+        rpc.register("store.op", self._h_op)
+        rpc.register("store.snapshot", self._h_snapshot)
+        rpc.register("store.seq", self._h_seq)
+        membership.monitor(self._on_membership)
+
+    def start_anti_entropy(self, interval_s: float = 5.0) -> None:
+        """Heal replica divergence from lost casts: if an origin's applied
+        seq stalls below its published seq across two checks, resync
+        (mnesia would instead fall back to a full table copy on reconnect)."""
+        self._ae_task = asyncio.get_running_loop().create_task(
+            self._ae_loop(interval_s))
+
+    def stop_anti_entropy(self) -> None:
+        if self._ae_task:
+            self._ae_task.cancel()
+
+    async def _ae_loop(self, interval_s: float) -> None:
+        from emqx_tpu.cluster.rpc import RpcError
+        while True:
+            await asyncio.sleep(interval_s)
+            for origin in self.membership.other_nodes():
+                try:
+                    rseq = await self.rpc.call(origin, "store.seq", [],
+                                               timeout=2)
+                except RpcError:
+                    continue
+                applied = self._applied.get(origin, 0)
+                if applied < rseq and self._lag_seen.get(origin) == applied:
+                    # no progress since last check: casts were lost
+                    await self._safe_sync(origin)
+                self._lag_seen[origin] = self._applied.get(origin, 0)
+
+    async def _h_seq(self) -> int:
+        return self._seq
+
+    def table(self, name: str) -> Table:
+        if name not in self.tables:
+            self.tables[name] = Table(name)
+        return self.tables[name]
+
+    # ---- writes: local apply + ordered broadcast ----
+    async def add(self, table: str, key: Any, value: Any) -> None:
+        await self._publish("add", table, key, value)
+
+    async def delete(self, table: str, key: Any, value: Any) -> None:
+        await self._publish("del", table, key, value)
+
+    async def _publish(self, op: str, table: str, key: Any,
+                       value: Any) -> None:
+        me = self.rpc.node
+        self._seq += 1
+        self.table(table)._apply(op, key, value, me)
+        for node in self.membership.other_nodes():
+            # key-pinned so one origin's ops for one route key stay ordered
+            await self.rpc.cast(node, "store.op",
+                                [me, self._seq, op, table, key, value],
+                                key=f"{table}:{key}")
+
+    async def _h_op(self, origin: str, seq: int, op: str, table: str,
+                    key: Any, value: Any) -> None:
+        if isinstance(key, list):        # tuple keys round-trip as JSON lists
+            key = tuple(key)
+        last = self._applied.get(origin, 0)
+        if seq <= last:
+            return                          # duplicate
+        buf = self._buffer.setdefault(origin, {})
+        buf[seq] = (op, table, key, value)
+        while last + 1 in buf:
+            last += 1
+            o, t, k, v = buf.pop(last)
+            self.table(t)._apply(o, k, v, origin)
+        self._applied[origin] = last
+        # a gap means casts raced ahead on different channels; the buffered
+        # ops apply the moment the missing seq arrives
+
+    # ---- snapshot sync (mnesia copy_table analog) ----
+    def _snapshot(self) -> dict:
+        me = self.rpc.node
+        out: dict = {"seq": self._seq, "tables": {}}
+        for name, tab in self.tables.items():
+            rows = []
+            for key, per in tab.rows.items():
+                for v in per.get(me, []):
+                    rows.append([key, v])
+            out["tables"][name] = rows
+        return out
+
+    async def _h_snapshot(self) -> dict:
+        return self._snapshot()
+
+    async def sync_from(self, node: str) -> None:
+        """Pull `node`'s own entries (its single-writer set) wholesale."""
+        snap = await self.rpc.call(node, "store.snapshot", [])
+        self.purge_origin(node)
+        for name, rows in snap["tables"].items():
+            tab = self.table(name)
+            for key, v in rows:
+                if isinstance(key, list):
+                    key = tuple(key)
+                tab._apply("add", key, v, node)
+        self._applied[node] = snap["seq"]
+        self._buffer.pop(node, None)
+
+    # ---- failure cleanup (emqx_router_helper:cleanup_routes, §3.5) ----
+    def purge_origin(self, origin: str) -> None:
+        for tab in self.tables.values():
+            for key in list(tab.rows):
+                per = tab.rows[key]
+                for v in per.get(origin, [])[:]:
+                    tab._apply("del", key, v, origin)
+
+    def _on_membership(self, event: str, node: str) -> None:
+        if event in ("nodedown", "nodeleft"):
+            self.purge_origin(node)
+        elif event in ("nodeup", "healed"):
+            # resync that origin's current state (it may have mutated while
+            # partitioned — the autoheal path)
+            try:
+                asyncio.get_running_loop().create_task(self._safe_sync(node))
+            except RuntimeError:
+                pass   # no loop (sync test context): peer syncs on join
+
+    async def _safe_sync(self, node: str) -> None:
+        try:
+            await self.sync_from(node)
+        except Exception:  # noqa: BLE001
+            log.info("snapshot sync from %s failed (will heal on next beat)",
+                     node)

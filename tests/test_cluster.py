@@ -1,0 +1,407 @@
+"""Cluster layer tests: a real in-process multi-node harness over localhost
+TCP — the analog of the reference's two-node docker cluster script
+(scripts/start-two-nodes-in-docker.sh) and takeover suite
+(emqx_takeover_SUITE.erl)."""
+
+import asyncio
+
+import pytest
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 30))
+
+from emqx_tpu.broker.node import Node
+from emqx_tpu.broker.session import Session, SessionConf
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.cluster.rpc import RpcError, RpcNode
+
+
+class Capture:
+    def __init__(self, nack=False):
+        self.msgs = []
+        self.nack = nack
+
+    def deliver(self, topic_filter, msg):
+        if self.nack:
+            return False
+        self.msgs.append((topic_filter, msg))
+        return True
+
+
+async def make_cluster(n=2, **kw):
+    nodes, clusters = [], []
+    for i in range(n):
+        node = Node(use_device=False, name=f"n{i}@127.0.0.1")
+        cn = ClusterNode(node, port=0, heartbeat_s=0.05, **kw)
+        await cn.start()
+        nodes.append(node)
+        clusters.append(cn)
+    for cn in clusters[1:]:
+        await cn.join(*clusters[0].address)
+    return nodes, clusters
+
+
+async def teardown(clusters):
+    for cn in clusters:
+        try:
+            await cn.stop()
+        except Exception:
+            pass
+
+
+async def settle(clusters, t=0.15):
+    for cn in clusters:
+        await cn.flush()
+    await asyncio.sleep(t)
+
+
+def test_rpc_call_cast_roundtrip(loop):
+    run(loop, _test_rpc_call_cast_roundtrip())
+
+
+async def _test_rpc_call_cast_roundtrip():
+    a = RpcNode("a@x", port=0)
+    b = RpcNode("b@x", port=0)
+    got = []
+
+    async def echo(x):
+        return {"echo": x}
+
+    async def note(x):
+        got.append(x)
+
+    b.register("echo", echo)
+    b.register("note", note)
+    await a.start()
+    await b.start()
+    a.add_peer("b@x", *b.address)
+    assert (await a.call("b@x", "echo", [b"bytes\x00"]))["echo"] == b"bytes\x00"
+    await a.cast("b@x", "note", [42], key="t/1")
+    await asyncio.sleep(0.05)
+    assert got == [42]
+    with pytest.raises(RpcError):
+        await a.call("b@x", "missing_fn", [])
+    res = await a.multicall(["b@x"], "echo", [1])
+    assert res["b@x"]["echo"] == 1
+    await a.stop()
+    await b.stop()
+
+
+def test_route_replication_and_forwarding(loop):
+    run(loop, _test_route_replication_and_forwarding())
+
+
+async def _test_route_replication_and_forwarding():
+    nodes, clusters = await make_cluster(2)
+    try:
+        b0, b1 = nodes[0].broker, nodes[1].broker
+        cap = Capture()
+        sid = b0.register(cap, "c-sub")
+        b0.subscribe(sid, "sensors/+/temp")
+        b0.subscribe(sid, "exact/topic")
+        await settle(clusters)
+        # routes replicated into n1's trie
+        assert "sensors/+/temp" in b1.router.topics()
+        assert "exact/topic" in b1.router.topics()
+        # publish on n1 -> forwarded -> delivered on n0
+        from emqx_tpu.broker.message import make
+        n = b1.publish(make("pub", 1, "sensors/9/temp", b"21.5"))
+        assert n == 1          # one remote node forward counted
+        await settle(clusters)
+        assert [m.payload for _, m in cap.msgs] == [b"21.5"]
+        assert cap.msgs[0][1].qos == 1
+        # unsubscribe propagates deletion
+        b0.unsubscribe(sid, "sensors/+/temp")
+        await settle(clusters)
+        assert "sensors/+/temp" not in b1.router.topics()
+        assert b1.publish(make("pub", 0, "sensors/9/temp", b"x")) == 0
+    finally:
+        await teardown(clusters)
+
+
+def test_local_and_remote_subscribers_both_deliver(loop):
+    run(loop, _test_local_and_remote_subscribers_both_deliver())
+
+
+async def _test_local_and_remote_subscribers_both_deliver():
+    nodes, clusters = await make_cluster(2)
+    try:
+        b0, b1 = nodes[0].broker, nodes[1].broker
+        c0, c1 = Capture(), Capture()
+        b0.subscribe(b0.register(c0, "s0"), "t/#")
+        b1.subscribe(b1.register(c1, "s1"), "t/#")
+        await settle(clusters)
+        from emqx_tpu.broker.message import make
+        b1.publish(make("pub", 0, "t/x", b"hello"))
+        await settle(clusters)
+        assert len(c0.msgs) == 1 and len(c1.msgs) == 1
+    finally:
+        await teardown(clusters)
+
+
+def test_shared_sub_cluster_wide_single_delivery(loop):
+    run(loop, _test_shared_sub_cluster_wide_single_delivery())
+
+
+async def _test_shared_sub_cluster_wide_single_delivery():
+    nodes, clusters = await make_cluster(2)
+    try:
+        b0, b1 = nodes[0].broker, nodes[1].broker
+        c0, c1 = Capture(), Capture()
+        b0.subscribe(b0.register(c0, "m0"), "$share/g/jobs/+")
+        b1.subscribe(b1.register(c1, "m1"), "$share/g/jobs/+")
+        await settle(clusters)
+        from emqx_tpu.broker.message import make
+        N = 10
+        for i in range(N):
+            b0.publish(make("pub", 0, "jobs/run", b"%d" % i))
+        await settle(clusters)
+        # each message delivered to exactly ONE member cluster-wide
+        assert len(c0.msgs) + len(c1.msgs) == N
+        # round_robin alternates across nodes
+        assert len(c0.msgs) == N // 2 and len(c1.msgs) == N // 2
+    finally:
+        await teardown(clusters)
+
+
+def test_nodedown_purges_remote_routes(loop):
+    run(loop, _test_nodedown_purges_remote_routes())
+
+
+async def _test_nodedown_purges_remote_routes():
+    nodes, clusters = await make_cluster(2)
+    try:
+        b0, b1 = nodes[0].broker, nodes[1].broker
+        cap = Capture()
+        b1.subscribe(b1.register(cap, "away"), "gone/+")
+        await settle(clusters)
+        assert "gone/+" in b0.router.topics()
+        await clusters[1].stop()   # n1 dies
+        await asyncio.sleep(0.5)   # > heartbeat * max_missed
+        assert not clusters[0].membership.is_running("n1@127.0.0.1")
+        assert "gone/+" not in b0.router.topics()
+    finally:
+        await teardown(clusters)
+
+
+def test_cross_node_session_takeover(loop):
+    run(loop, _test_cross_node_session_takeover())
+
+
+async def _test_cross_node_session_takeover():
+    nodes, clusters = await make_cluster(2)
+    try:
+        cm0, cm1 = nodes[0].cm, nodes[1].cm
+        # a persistent session parked on n0 with state in every pocket
+        s = Session("dev-1", SessionConf(session_expiry_interval=300))
+        s.subscribe("a/+", {"qos": 1})
+        from emqx_tpu.broker.message import make
+        s.enqueue([(make("x", 1, "a/b", b"queued"), {"qos": 1})])
+        # park_session itself registers the clientid cluster-wide
+        cm0.park_session("dev-1", s)
+        await settle(clusters)
+        # client reconnects on n1 with clean_start=False
+        sess, present = await cm1.open_session(
+            False, "dev-1", SessionConf(), new_channel=object())
+        assert present
+        assert sess.subscriptions == {"a/+": {"qos": 1}}
+        assert [m.payload for m in sess.mqueue.to_list()] == [b"queued"]
+        assert cm0.parked_count() == 0   # moved, not copied
+    finally:
+        await teardown(clusters)
+
+
+def test_clean_start_discards_remote_session(loop):
+    run(loop, _test_clean_start_discards_remote_session())
+
+
+async def _test_clean_start_discards_remote_session():
+    nodes, clusters = await make_cluster(2)
+    try:
+        cm0, cm1 = nodes[0].cm, nodes[1].cm
+        s = Session("dev-2", SessionConf(session_expiry_interval=300))
+        cm0.park_session("dev-2", s)
+        await settle(clusters)
+        sess, present = await cm1.open_session(
+            True, "dev-2", SessionConf(), new_channel=object())
+        assert not present
+        await settle(clusters)
+        assert cm0.parked_count() == 0
+    finally:
+        await teardown(clusters)
+
+
+def test_kick_session_global(loop):
+    run(loop, _test_kick_session_global())
+
+
+async def _test_kick_session_global():
+    nodes, clusters = await make_cluster(2)
+    try:
+        kicked = []
+
+        class Chan:
+            async def kick(self, reason):
+                kicked.append(reason)
+
+            async def takeover_begin(self):
+                return None
+
+            async def takeover_end(self):
+                return []
+
+        nodes[0].cm.register_channel("k-1", Chan())
+        await settle(clusters)
+        assert await clusters[1].kick_session_global("k-1")
+        assert kicked == ["kicked"]
+        assert not await clusters[1].kick_session_global("nobody")
+    finally:
+        await teardown(clusters)
+
+
+def test_three_node_gossip_join(loop):
+    run(loop, _test_three_node_gossip_join())
+
+
+async def _test_three_node_gossip_join():
+    nodes, clusters = await make_cluster(3)
+    try:
+        await asyncio.sleep(0.2)
+        for cn in clusters:
+            assert len(cn.membership.running_nodes()) == 3
+        # route from n2 visible on n0 and n1
+        b2 = nodes[2].broker
+        b2.subscribe(b2.register(Capture(), "x"), "tri/+/route")
+        await settle(clusters)
+        assert "tri/+/route" in nodes[0].broker.router.topics()
+        assert "tri/+/route" in nodes[1].broker.router.topics()
+    finally:
+        await teardown(clusters)
+
+
+def test_distributed_lock_mutual_exclusion(loop):
+    run(loop, _test_distributed_lock_mutual_exclusion())
+
+
+async def _test_distributed_lock_mutual_exclusion():
+    nodes, clusters = await make_cluster(2)
+    try:
+        order = []
+
+        async def critical(cn, tag):
+            async with cn.lock("same-client"):
+                order.append(f"{tag}-in")
+                await asyncio.sleep(0.05)
+                order.append(f"{tag}-out")
+
+        await asyncio.gather(critical(clusters[0], "a"),
+                             critical(clusters[1], "b"))
+        # no interleaving: each -in is followed by its own -out
+        assert order[0][0] == order[1][0] and order[2][0] == order[3][0]
+    finally:
+        await teardown(clusters)
+
+
+def test_qos2_pubrel_session_survives_takeover(loop):
+    run(loop, _test_qos2_pubrel_session_survives_takeover())
+
+
+async def _test_qos2_pubrel_session_survives_takeover():
+    """Regression: pubrel-phase inflight entries hold a Message too and must
+    serialize across nodes."""
+    nodes, clusters = await make_cluster(2)
+    try:
+        cm0, cm1 = nodes[0].cm, nodes[1].cm
+        from emqx_tpu.broker.message import make
+        s = Session("q2", SessionConf(session_expiry_interval=300))
+        s.enqueue([(make("x", 2, "a/b", b"m1"), {"qos": 2})])
+        [(pid, _m)] = s.dequeue()
+        s.pubrec(pid)                       # -> ('pubrel', msg) phase
+        cm0.park_session("q2", s)
+        await settle(clusters)
+        sess, present = await cm1.open_session(
+            False, "q2", SessionConf(), new_channel=object())
+        assert present
+        entry = sess.inflight.lookup(pid)
+        assert entry[0] == "pubrel" and entry[1].payload == b"m1"
+    finally:
+        await teardown(clusters)
+
+
+def test_lock_lease_expires_after_holder_crash(loop):
+    run(loop, _test_lock_lease_expires_after_holder_crash())
+
+
+async def _test_lock_lease_expires_after_holder_crash():
+    nodes, clusters = await make_cluster(2)
+    try:
+        cn = clusters[0]
+        cn.LOCK_LEASE_S = 0.1
+        guard = cn.lock("crashy")
+        await guard.__aenter__()            # acquired, never released
+        await asyncio.sleep(0.15)           # lease expires
+        async with cn.lock("crashy"):       # must not hang
+            pass
+    finally:
+        await teardown(clusters)
+
+
+def test_anti_entropy_heals_lost_casts(loop):
+    run(loop, _test_anti_entropy_heals_lost_casts())
+
+
+async def _test_anti_entropy_heals_lost_casts():
+    """Drop a replication cast on the floor; the seq-probe resync repairs."""
+    nodes, clusters = await make_cluster(2)
+    try:
+        c0, c1 = clusters
+        # simulate a lost cast: bump c0's seq without broadcasting
+        c0.store._seq += 1
+        c0.store.table("route")._apply("add", "lost/+", "sub",
+                                       c0.rpc.node)
+        # subsequent replicated op now has a seq gap at c1
+        nodes[0].broker.subscribe(
+            nodes[0].broker.register(Capture(), "x"), "after/+")
+        await settle(clusters)
+        assert "after/+" not in nodes[1].broker.router.topics()  # stuck
+        # anti-entropy loop (interval 0.25s at heartbeat 0.05) heals it
+        for _ in range(40):
+            await asyncio.sleep(0.1)
+            if "after/+" in nodes[1].broker.router.topics():
+                break
+        assert "after/+" in nodes[1].broker.router.topics()
+        assert "lost/+" in c1.store.table("route").keys()
+    finally:
+        await teardown(clusters)
+
+
+def test_partition_heals_on_mutual_down(loop):
+    run(loop, _test_partition_heals_on_mutual_down())
+
+
+async def _test_partition_heals_on_mutual_down():
+    """Both sides mark each other down; probing down members heals it."""
+    nodes, clusters = await make_cluster(2)
+    try:
+        c0, c1 = clusters
+        n1 = c1.rpc.node
+        # force-mark each other down (simulated blip without killing TCP)
+        c0.membership.members[n1]["status"] = "down"
+        c1.membership.members[c0.rpc.node]["status"] = "down"
+        for _ in range(40):
+            await asyncio.sleep(0.05)
+            if (c0.membership.is_running(n1)
+                    and c1.membership.is_running(c0.rpc.node)):
+                break
+        assert c0.membership.is_running(n1)
+        assert c1.membership.is_running(c0.rpc.node)
+    finally:
+        await teardown(clusters)
