@@ -113,9 +113,10 @@ class ConnectionManager:
 
     async def discard_session(self, clientid: str) -> None:
         """Kick any existing channel and drop its session
-        (emqx_cm:discard_session)."""
-        old = self._channels.pop(clientid, None)
-        self._info.pop(clientid, None)
+        (emqx_cm:discard_session). Goes through unregister_channel so the
+        cluster registry entry is retired with the channel."""
+        old = self._channels.get(clientid)
+        self.unregister_channel(clientid)
         self.drop_parked(clientid)
         if old is not None:
             try:
@@ -125,10 +126,10 @@ class ConnectionManager:
 
     async def kick_session(self, clientid: str) -> bool:
         """Administrative kick (emqx_cm:kick_session)."""
-        old = self._channels.pop(clientid, None)
-        self._info.pop(clientid, None)
+        old = self._channels.get(clientid)
         if old is None:
             return False
+        self.unregister_channel(clientid)
         try:
             await old.kick("kicked")
         except Exception:

@@ -65,7 +65,11 @@ class ClusterNode:
         self._fwd_tasks: set[asyncio.Task] = set()
         self._shared_cursors: dict[tuple[str, str], int] = {}
         self._shared_sticky: dict[tuple[str, str], tuple[str, int]] = {}
-        self._lock_tab: dict[str, asyncio.Lock] = {}
+        self._lock_tab: dict[str, tuple] = {}   # clientid -> (token, deadline)
+        # secondary index over T_SHARED: real topic -> live group names,
+        # maintained from the table watcher (all origins) so the publish
+        # hot path never scans the whole table
+        self._groups_by_real: dict[str, set[str]] = {}
 
         self.rpc.register("broker.dispatch_fwd", self._h_dispatch_fwd)
         self.rpc.register("shared.deliver_fwd", self._h_shared_deliver)
@@ -94,7 +98,8 @@ class ClusterNode:
                 await self.store.sync_from(n)
             except RpcError:
                 pass
-        # publish our current local state (joined with live subscriptions)
+        # publish our current local state (joined with live subscriptions,
+        # connected channels, parked sessions)
         broker = self.node.broker
         for real in broker.subs:
             self.local_route_add(real)
@@ -102,6 +107,10 @@ class ClusterNode:
             for group, g in groups.items():
                 for sid in g.members:
                     self.shared_join(real, group, sid)
+        for clientid, _chan in self.node.cm.all_channels():
+            self.registry_register(clientid)
+        for clientid in self.node.cm._detached:
+            self.registry_register(clientid)
 
     async def stop(self) -> None:
         if self._repl_task:
@@ -168,18 +177,9 @@ class ClusterNode:
         """Drop the filter from the local trie once NO node routes it."""
         broker = self.node.broker
         if (not self.store.table(T_ROUTE).origins(real)
-                and not self.store.table(T_SHARED).origins(
-                    self._shared_keys_for(real))
+                and not self._groups_by_real.get(real)
                 and not broker._has_any_sub(real)):
             broker.router.delete_route(real)
-
-    def _shared_keys_for(self, real: str):
-        # any shared key for this real topic keeps the route alive
-        tab = self.store.table(T_SHARED)
-        for key in tab.rows:
-            if isinstance(key, tuple) and key[0] == real:
-                return key
-        return ("", "")
 
     def _on_route_event(self, op: str, key, value, origin: str) -> None:
         if origin == self.rpc.node:
@@ -202,9 +202,20 @@ class ClusterNode:
         self._gc_local_route(real)
 
     def _on_shared_event(self, op: str, key, value, origin: str) -> None:
+        if not isinstance(key, tuple):
+            return
+        real, group = key
+        # keep the real->groups index current for every origin (self too)
+        if op == "add":
+            self._groups_by_real.setdefault(real, set()).add(group)
+        elif not self.store.table(T_SHARED).rows.get(key):
+            groups = self._groups_by_real.get(real)
+            if groups:
+                groups.discard(group)
+                if not groups:
+                    del self._groups_by_real[real]
         if origin == self.rpc.node:
             return
-        real = key[0] if isinstance(key, tuple) else key
         if op == "add":
             self.node.broker.router.add_route(real)
         else:
@@ -258,13 +269,10 @@ class ClusterNode:
     # ---- cluster-wide shared dispatch ----
     def dispatch_shared(self, broker, msg: Message,
                         filters: list[str]) -> int:
-        tab = self.store.table(T_SHARED)
         n = 0
         for real in filters:
             groups: set[str] = set(broker.shared.get(real, {}))
-            for key in tab.rows:
-                if isinstance(key, tuple) and key[0] == real:
-                    groups.add(key[1])   # remote-only groups
+            groups |= self._groups_by_real.get(real, set())
             for group in groups:
                 if self._dispatch_one_group(broker, real, group, msg):
                     n += 1
@@ -476,15 +484,21 @@ class ClusterNode:
                              cluster.LOCK_LEASE_S],
                             key=clientid, timeout=35)
                     except RpcError:
-                        continue   # dead node: lease logic covers us
+                        continue   # unreachable node: lease logic covers us
                     if ok:
                         self.held.append(target)
                         ok_any = True
+                    else:
+                        # a REACHABLE target refused (still held elsewhere):
+                        # proceeding would break mutual exclusion — back out
+                        await self._release_held()
+                        raise RpcError(
+                            f"lock {clientid}: contended on {target}")
                 if not ok_any:
-                    raise RpcError(f"lock {clientid}: no target acquired")
+                    raise RpcError(f"lock {clientid}: no target reachable")
                 return self
 
-            async def __aexit__(self, *exc):
+            async def _release_held(self):
                 for target in self.held:
                     try:
                         await cluster.rpc.call(target, "locker.release",
@@ -492,6 +506,10 @@ class ClusterNode:
                                                key=clientid)
                     except RpcError:
                         pass   # lease expiry reclaims it
+                self.held = []
+
+            async def __aexit__(self, *exc):
+                await self._release_held()
                 return False
         return _Guard()
 
@@ -502,10 +520,9 @@ class ClusterNode:
         if event in ("nodedown", "nodeleft"):
             broker = self.node.broker
             tab = self.store.table(T_ROUTE)
-            stab = self.store.table(T_SHARED)
-            live_shared = {k[0] for k in stab.rows if isinstance(k, tuple)}
             for f in list(broker.router.topics()):
-                if (not tab.origins(f) and f not in live_shared
+                if (not tab.origins(f)
+                        and not self._groups_by_real.get(f)
                         and not broker._has_any_sub(f)):
                     broker.router.delete_route(f)
 

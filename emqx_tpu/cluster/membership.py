@@ -156,13 +156,18 @@ class Membership:
                 if node == self.rpc.node:
                     continue
                 try:
-                    await self.rpc.call(node, "ekka.heartbeat",
-                                        [self.rpc.node],
-                                        timeout=self.heartbeat_s * 2)
+                    # heartbeats carry the full view both ways: missed
+                    # join-time gossip heals on the next beat
+                    rview = await self.rpc.call(
+                        node, "ekka.heartbeat",
+                        [self.rpc.node, self._view()],
+                        timeout=self.heartbeat_s * 2)
                     m["last"] = now
                     if m["status"] == "down":
                         m["status"] = "running"
                         self._emit("healed", node)
+                    if isinstance(rview, dict):
+                        self._merge_view(rview)
                 except RpcError:
                     pass
             self._check_down(now)
@@ -180,14 +185,17 @@ class Membership:
                 del self.members[node]   # cluster_autoclean
                 self._emit("nodeleft", node)
 
-    async def _h_heartbeat(self, from_node: str) -> str:
+    async def _h_heartbeat(self, from_node: str,
+                           view: Optional[dict] = None) -> dict:
+        if view:
+            self._merge_view(view)   # learns unknown senders/members too
         m = self.members.get(from_node)
         if m is not None:
             m["last"] = time.time()
             if m["status"] == "down":   # autoheal: it came back
                 m["status"] = "running"
                 self._emit("healed", from_node)
-        return self.rpc.node
+        return self._view()
 
     async def stop(self) -> None:
         if self._task:

@@ -405,3 +405,73 @@ async def _test_partition_heals_on_mutual_down():
         assert c1.membership.is_running(c0.rpc.node)
     finally:
         await teardown(clusters)
+
+
+def test_lock_contention_fails_closed(loop):
+    run(loop, _test_lock_contention_fails_closed())
+
+
+async def _test_lock_contention_fails_closed():
+    """A reachable-but-contended lock target must FAIL the acquire, not be
+    skipped (mutual exclusion over partial failures)."""
+    nodes, clusters = await make_cluster(2)
+    try:
+        cn0, cn1 = clusters
+        g0 = cn0.lock("cid-x")
+        await g0.__aenter__()
+        t0 = asyncio.get_running_loop().time()
+        # second acquire with a short lease window: contended targets make
+        # it spin in locker.acquire until the 30s server-side deadline; we
+        # only need to see that it does NOT succeed immediately
+        task = asyncio.ensure_future(cn1.lock("cid-x").__aenter__())
+        await asyncio.sleep(0.2)
+        assert not task.done(), "contended lock must not be granted"
+        await g0.__aexit__(None, None, None)
+        guard = await task          # now it proceeds
+        assert asyncio.get_running_loop().time() - t0 >= 0.2
+        await guard.__aexit__(None, None, None)
+    finally:
+        await teardown(clusters)
+
+
+def test_kick_discard_retire_registry(loop):
+    run(loop, _test_kick_discard_retire_registry())
+
+
+async def _test_kick_discard_retire_registry():
+    nodes, clusters = await make_cluster(2)
+    try:
+        class Chan:
+            async def kick(self, reason):
+                pass
+
+        nodes[0].cm.register_channel("gone-1", Chan())
+        await settle(clusters)
+        assert clusters[1].registry_lookup("gone-1") == ["n0@127.0.0.1"]
+        await nodes[0].cm.kick_session("gone-1")
+        await settle(clusters)
+        assert clusters[1].registry_lookup("gone-1") == []
+    finally:
+        await teardown(clusters)
+
+
+def test_heartbeat_view_merge_heals_asymmetry(loop):
+    run(loop, _test_heartbeat_view_merge_heals_asymmetry())
+
+
+async def _test_heartbeat_view_merge_heals_asymmetry():
+    """A member one node never heard about arrives via heartbeat views."""
+    nodes, clusters = await make_cluster(3)
+    try:
+        c2 = clusters[2]
+        victim = c2.rpc.node
+        # simulate c0 having missed the join gossip for c2 entirely
+        clusters[0].membership.members.pop(victim, None)
+        run_for = 30
+        for _ in range(run_for):
+            await asyncio.sleep(0.1)
+            if victim in clusters[0].membership.members:
+                break
+        assert victim in clusters[0].membership.members
+    finally:
+        await teardown(clusters)

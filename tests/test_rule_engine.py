@@ -1,0 +1,318 @@
+"""Rule engine tests.
+
+Mirrors the reference's emqx_rule_engine_SUITE / emqx_rule_funcs_SUITE:
+SQL parse/eval, event columns from live hooks, FOREACH, functions,
+republish action with loop protection, per-rule metrics."""
+
+import json
+
+import pytest
+
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+from emqx_tpu.rules import RuleEngine, parse_sql
+from emqx_tpu.rules.funcs import FUNCS, call
+from emqx_tpu.rules.runtime import apply_sql
+from emqx_tpu.rules.sqlparser import SqlError
+
+
+def sql_run(sql, event):
+    return apply_sql(parse_sql(sql), event)
+
+
+class TestParser:
+    def test_select_star(self):
+        ast = parse_sql('SELECT * FROM "t/#"')
+        assert ast["type"] == "select" and ast["from"] == ["t/#"]
+
+    def test_multi_topics_and_where(self):
+        ast = parse_sql('SELECT a FROM "t/1", "t/2" WHERE a > 1')
+        assert ast["from"] == ["t/1", "t/2"]
+        assert ast["where"][0] == "bin"
+
+    def test_foreach(self):
+        ast = parse_sql('FOREACH payload.sensors AS s DO s.id as id '
+                        'INCASE s.ok = true FROM "t"')
+        assert ast["type"] == "foreach" and ast["alias"] == "s"
+
+    def test_errors(self):
+        with pytest.raises(SqlError):
+            parse_sql('SELECT FROM "t"')
+        with pytest.raises(SqlError):
+            parse_sql('UPDATE x')
+        with pytest.raises(SqlError):
+            parse_sql('SELECT a FROM t')     # unquoted topic
+
+    def test_case_when(self):
+        out = sql_run("SELECT CASE WHEN qos = 0 THEN 'low' "
+                      "ELSE 'high' END as level FROM \"t\"",
+                      {"qos": 0})
+        assert out == [{"level": "low"}]
+
+
+class TestSelect:
+    EVENT = {"topic": "t/1", "qos": 1, "clientid": "c1",
+             "payload": json.dumps({"x": 1, "y": {"z": [10, 20]}}),
+             "timestamp": 1700000000000}
+
+    def test_star(self):
+        [out] = sql_run('SELECT * FROM "t/#"', self.EVENT)
+        assert out["topic"] == "t/1" and out["clientid"] == "c1"
+
+    def test_nested_payload_and_alias(self):
+        [out] = sql_run('SELECT payload.x as x, payload.y.z[2] as z2 '
+                        'FROM "t/#"', self.EVENT)
+        assert out == {"x": 1, "z2": 20}
+
+    def test_selected_visible_to_later_fields_and_where(self):
+        [out] = sql_run('SELECT payload.x as x, x + 10 as y FROM "t/#" '
+                        'WHERE y > 10', self.EVENT)
+        assert out == {"x": 1, "y": 11}
+        assert sql_run('SELECT payload.x as x FROM "t/#" WHERE x > 99',
+                       self.EVENT) == []
+
+    def test_dotted_alias_builds_nested(self):
+        [out] = sql_run('SELECT qos as meta.qos FROM "t/#"', self.EVENT)
+        assert out == {"meta": {"qos": 1}}
+
+    def test_arith_and_compare(self):
+        [out] = sql_run("SELECT 3 + 4 * 2 as a, 7 div 2 as b, 7 mod 2 as c, "
+                        "-qos as d FROM \"t\"", self.EVENT)
+        assert out == {"a": 11, "b": 3, "c": 1, "d": -1}
+
+    def test_string_eq_and_regex(self):
+        assert sql_run("SELECT 1 as one FROM \"t\" WHERE clientid = 'c1'",
+                       self.EVENT)
+        assert sql_run("SELECT 1 as one FROM \"t\" WHERE topic =~ '^t/'",
+                       self.EVENT)
+        assert not sql_run("SELECT 1 as one FROM \"t\" "
+                           "WHERE clientid = 'other'", self.EVENT)
+
+    def test_and_or_not(self):
+        assert sql_run("SELECT 1 as x FROM \"t\" WHERE qos = 1 and "
+                       "(clientid = 'c1' or clientid = 'c2')", self.EVENT)
+        assert not sql_run("SELECT 1 as x FROM \"t\" WHERE not (qos = 1)",
+                           self.EVENT)
+
+
+class TestForeach:
+    EVENT = {"topic": "t", "payload": json.dumps(
+        {"sensors": [{"id": 1, "temp": 20}, {"id": 2, "temp": 31},
+                     {"id": 3, "temp": 5}]})}
+
+    def test_explode(self):
+        outs = sql_run('FOREACH payload.sensors FROM "t"', self.EVENT)
+        assert len(outs) == 3 and outs[0]["id"] == 1
+
+    def test_do_incase(self):
+        outs = sql_run('FOREACH payload.sensors AS s '
+                       'DO s.id as id, s.temp as temp '
+                       'INCASE s.temp > 10 FROM "t"', self.EVENT)
+        assert outs == [{"id": 1, "temp": 20}, {"id": 2, "temp": 31}]
+
+    def test_non_array_is_no_result(self):
+        assert sql_run('FOREACH payload.missing FROM "t"', self.EVENT) == []
+
+
+class TestFuncs:
+    def test_arith_concat(self):
+        assert call("+", [1, 2]) == 3
+        assert call("+", ["a", "b"]) == "ab"
+
+    def test_strings(self):
+        assert call("lower", ["ABC"]) == "abc"
+        assert call("substr", ["abcdef", 2]) == "cdef"
+        assert call("substr", ["abcdef", 1, 3]) == "bcd"
+        assert call("split", ["a/b/c", "/"]) == ["a", "b", "c"]
+        assert call("concat", ["ab", 12]) == "ab12"
+        assert call("pad", ["ab", 5]) == "ab   "
+        assert call("pad", ["ab", 5, "leading", "0"]) == "000ab"
+        assert call("replace", ["a,b,c", ",", "-"]) == "a-b-c"
+        assert call("regex_match", ["abc123", r"\d+"]) is True
+        assert call("regex_replace", ["ab12", r"\d", "x"]) == "abxx"
+        assert call("find", ["hello world", "wor"]) == "world"
+        assert call("ascii", ["A"]) == 65
+        assert call("sprintf_s", ["~s-~s", "a", "b"]) == "a-b"
+
+    def test_numbers_and_bits(self):
+        assert call("abs", [-3]) == 3
+        assert call("power", [2, 10]) == 1024
+        assert call("round", [2.5]) == 2  # banker's rounding, like erlang? no
+        assert call("bitand", [6, 3]) == 2
+        assert call("bitsl", [1, 4]) == 16
+        assert call("bitsize", [b"ab"]) == 16
+        assert call("subbits", [bytes([0b10110000]), 3]) == 0b101
+
+    def test_subbits_typed(self):
+        # 16-bit signed big-endian -1
+        assert call("subbits", [b"\xff\xff", 1, 16, "integer", "signed",
+                                "big"]) == -1
+        assert call("subbits", [b"\x01\x00", 1, 16, "integer", "unsigned",
+                                "little"]) == 1
+
+    def test_conversion(self):
+        assert call("int", ["42"]) == 42
+        assert call("int", [True]) == 1
+        assert call("float", ["1.5"]) == 1.5
+        assert call("bool", ["true"]) is True
+        assert call("bin2hexstr", [b"\xde\xad"]) == "DEAD"
+        assert call("hexstr2bin", ["dead"]) == b"\xde\xad"
+        assert call("map", ['{"a":1}']) == {"a": 1}
+
+    def test_validation(self):
+        assert call("is_null", [None]) and call("is_not_null", [1])
+        assert call("is_int", [1]) and not call("is_int", [True])
+        assert call("is_num", [1.5]) and call("is_array", [[1]])
+
+    def test_maps_arrays(self):
+        assert call("map_get", ["a.b", {"a": {"b": 7}}]) == 7
+        assert call("map_put", ["a.c", 9, {"a": {}}]) == {"a": {"c": 9}}
+        assert call("nth", [2, [10, 20, 30]]) == 20
+        assert call("first", [[1, 2]]) == 1 and call("last", [[1, 2]]) == 2
+        assert call("sublist", [2, [1, 2, 3]]) == [1, 2]
+        assert call("sublist", [2, 2, [1, 2, 3]]) == [2, 3]
+        assert call("contains", [2, [1, 2]]) is True
+
+    def test_hash_codec(self):
+        assert call("md5", ["abc"]) == "900150983cd24fb0d6963f7d28e17f72"
+        assert call("base64_decode", [call("base64_encode", [b"xy"])]) == b"xy"
+        assert call("json_decode", ['{"k":1}']) == {"k": 1}
+        assert json.loads(call("json_encode", [{"k": 1}])) == {"k": 1}
+
+    def test_dates(self):
+        ts = call("now_timestamp", [])
+        assert isinstance(ts, int) and ts > 1_600_000_000
+        s = call("unix_ts_to_rfc3339", [1700000000])
+        assert s.startswith("2023-11-14T")
+        assert call("rfc3339_to_unix_ts", [s]) == 1700000000
+
+    def test_kv(self):
+        call("kv_store_put", ["k1", 42])
+        assert call("kv_store_get", ["k1"]) == 42
+        call("kv_store_del", ["k1"])
+        assert call("kv_store_get", ["k1", "gone"]) == "gone"
+
+    def test_coverage_of_reference_exports(self):
+        # spot-check the function table covers the reference's export groups
+        for name in ("acos", "atanh", "fmod", "log2", "tanh", "bitxor",
+                     "subbits", "str_utf8", "is_map", "tokens", "mget",
+                     "mput", "length", "sha256", "term_encode",
+                     "now_rfc3339", "proc_dict_get", "null"):
+            assert name in FUNCS, name
+
+
+class TestEngine:
+    @pytest.fixture()
+    def node(self):
+        n = Node(use_device=False)
+        RuleEngine(n).load()
+        return n
+
+    class Cap:
+        def __init__(self):
+            self.msgs = []
+
+        def deliver(self, f, m):
+            self.msgs.append(m)
+            return True
+
+    def test_publish_rule_republish(self, node):
+        eng = node.rule_engine
+        rule = eng.create_rule(
+            'SELECT payload.temp as t, topic FROM "sensors/#" '
+            'WHERE t > 30',
+            [{"name": "republish",
+              "params": {"target_topic": "alerts/${topic}",
+                         "payload_tmpl": '{"hot":${t}}'}}])
+        cap = self.Cap()
+        sid = node.broker.register(cap, "alert-sub")
+        node.broker.subscribe(sid, "alerts/#")
+        node.broker.publish(make("c1", 0, "sensors/a",
+                                 json.dumps({"temp": 35}).encode()))
+        node.broker.publish(make("c1", 0, "sensors/a",
+                                 json.dumps({"temp": 5}).encode()))
+        assert len(cap.msgs) == 1
+        assert cap.msgs[0].topic == "alerts/sensors/a"
+        assert json.loads(cap.msgs[0].payload) == {"hot": 35}
+        m = rule.metrics
+        assert m.val("sql.matched") == 2 and m.val("sql.passed") == 1
+        assert m.val("sql.failed.no_result") == 1
+        assert m.val("actions.success") == 1
+
+    def test_republish_loop_protection(self, node):
+        eng = node.rule_engine
+        eng.create_rule('SELECT * FROM "loop/#"',
+                        [{"name": "republish",
+                          "params": {"target_topic": "loop/again"}}])
+        node.broker.publish(make("c1", 0, "loop/start", b"x"))
+        # first republish fires; republishing the republished message is
+        # refused and counted as an action error
+        [rule] = eng.list_rules()
+        assert rule.metrics.val("actions.success") == 1
+        assert rule.metrics.val("actions.error") == 1
+        assert rule.metrics.val("sql.matched") == 2  # saw both, acted once
+
+    def test_topic_filter_gates_rule(self, node):
+        eng = node.rule_engine
+        r = eng.create_rule('SELECT * FROM "only/+/this"', [
+            {"name": "do_nothing", "params": {}}])
+        node.broker.publish(make("c", 0, "other/topic", b""))
+        assert r.metrics.val("sql.matched") == 0
+        node.broker.publish(make("c", 0, "only/x/this", b""))
+        assert r.metrics.val("sql.matched") == 1
+
+    def test_event_rule_client_connected(self, node):
+        eng = node.rule_engine
+        r = eng.create_rule(
+            'SELECT clientid, username, proto_ver '
+            'FROM "$events/client_connected"',
+            [{"name": "do_nothing", "params": {}}])
+        node.hooks.run("client.connected",
+                       ({"clientid": "dev9", "username": "u"},
+                        {"proto_ver": 5, "keepalive": 60}))
+        assert r.metrics.val("sql.passed") == 1
+
+    def test_event_rule_message_dropped(self, node):
+        eng = node.rule_engine
+        r = eng.create_rule(
+            'SELECT reason, topic FROM "$events/message_dropped"',
+            [{"name": "do_nothing", "params": {}}])
+        node.broker.publish(make("c", 0, "no/subs/here", b""))
+        assert r.metrics.val("sql.passed") == 1
+
+    def test_disable_delete(self, node):
+        eng = node.rule_engine
+        r = eng.create_rule('SELECT * FROM "d/#"',
+                            [{"name": "do_nothing", "params": {}}])
+        eng.enable_rule(r.id, False)
+        node.broker.publish(make("c", 0, "d/x", b""))
+        assert r.metrics.val("sql.matched") == 0
+        eng.enable_rule(r.id, True)
+        node.broker.publish(make("c", 0, "d/x", b""))
+        assert r.metrics.val("sql.matched") == 1
+        assert eng.delete_rule(r.id)
+        node.broker.publish(make("c", 0, "d/x", b""))
+        assert r.metrics.val("sql.matched") == 1
+
+    def test_foreach_rule_fires_action_per_item(self, node):
+        eng = node.rule_engine
+        seen = []
+        from emqx_tpu.rules.actions import BUILTIN_ACTIONS
+        BUILTIN_ACTIONS["_test_collect"] = \
+            lambda nd, p, cols, envs: seen.append(cols)
+        try:
+            eng.create_rule(
+                'FOREACH payload.readings AS r DO r.v as v INCASE r.v > 0 '
+                'FROM "batch/#"',
+                [{"name": "_test_collect", "params": {}}])
+            node.broker.publish(make("c", 0, "batch/1", json.dumps(
+                {"readings": [{"v": 1}, {"v": -2}, {"v": 3}]}).encode()))
+        finally:
+            del BUILTIN_ACTIONS["_test_collect"]
+        assert seen == [{"v": 1}, {"v": 3}]
+
+    def test_sql_tester(self, node):
+        out = node.rule_engine.test_sql(
+            'SELECT payload.x as x FROM "t/#" WHERE x = 1',
+            {"topic": "t/1", "payload": '{"x": 1}'})
+        assert out == [{"x": 1}]
