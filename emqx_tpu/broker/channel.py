@@ -482,9 +482,8 @@ class Channel:
     async def _do_subscribe(self, tf: str, opts: dict, subid) -> int:
         try:
             real, popts = T.parse(tf, opts)
+            T.validate(real, "filter")   # raises TopicError when invalid
         except T.TopicError:
-            return C.RC_TOPIC_FILTER_INVALID
-        if not T.validate(real, "filter"):
             return C.RC_TOPIC_FILTER_INVALID
         if T.levels(real) > self.mqtt.get("max_topic_levels", 128):
             return C.RC_TOPIC_FILTER_INVALID
@@ -527,26 +526,34 @@ class Channel:
         filters = self.node.hooks.run_fold(
             "client.unsubscribe", (self.clientinfo, pkt.properties or {}),
             list(pkt.filters))
-        codes = []
-        for tf in filters:
-            try:
-                real, popts = T.parse(tf)
-            except T.TopicError:
-                codes.append(C.RC_TOPIC_FILTER_INVALID)
-                continue
-            mounted_real = self._mount(real)
-            group = popts.get("share")
-            full = (f"$share/{group}/{mounted_real}" if group
-                    else mounted_real)
-            self.node.broker.unsubscribe(self.sid, full)
-            try:
-                self.session.unsubscribe(full)
-                self.node.hooks.run("session.unsubscribed",
-                                    (self.clientinfo, mounted_real))
-                codes.append(C.RC_SUCCESS)
-            except SessionError:
-                codes.append(C.RC_NO_SUBSCRIPTION_EXISTED)
+        codes = [self._do_unsubscribe(tf) for tf in filters]
         self._send([P.Unsuback(packet_id=pkt.packet_id, reason_codes=codes)])
+
+    def _do_unsubscribe(self, tf: str) -> int:
+        try:
+            real, popts = T.parse(tf)
+        except T.TopicError:
+            return C.RC_TOPIC_FILTER_INVALID
+        mounted_real = self._mount(real)
+        group = popts.get("share")
+        full = (f"$share/{group}/{mounted_real}" if group
+                else mounted_real)
+        self.node.broker.unsubscribe(self.sid, full)
+        try:
+            self.session.unsubscribe(full)
+        except SessionError:
+            return C.RC_NO_SUBSCRIPTION_EXISTED
+        self.node.hooks.run("session.unsubscribed",
+                            (self.clientinfo, mounted_real))
+        return C.RC_SUCCESS
+
+    # ---- management-initiated subscribe/unsubscribe (emqx_mgmt:subscribe
+    # sends the request into the client's channel process) ----
+    async def mgmt_subscribe(self, topic_filter: str, qos: int = 0) -> int:
+        return await self._do_subscribe(topic_filter, {"qos": qos}, None)
+
+    def mgmt_unsubscribe(self, topic_filter: str) -> bool:
+        return self._do_unsubscribe(topic_filter) == C.RC_SUCCESS
 
     # ================= DISCONNECT =================
     def _handle_disconnect(self, pkt: P.Disconnect) -> None:

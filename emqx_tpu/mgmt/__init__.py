@@ -1,0 +1,13 @@
+"""Management: REST API + CLI + cross-node fan-out.
+
+Parity: apps/emqx_management — emqx_mgmt.erl (facade), emqx_mgmt_http/
+emqx_mgmt_api_*.erl (REST over minirest), emqx_mgmt_cli.erl (emqx_ctl
+commands), emqx_mgmt_auth.erl (app id/secret credentials).
+"""
+
+from emqx_tpu.mgmt.api import make_api
+from emqx_tpu.mgmt.cli import Cli
+from emqx_tpu.mgmt.httpd import HttpServer
+from emqx_tpu.mgmt.mgmt import Mgmt
+
+__all__ = ["Mgmt", "make_api", "HttpServer", "Cli"]

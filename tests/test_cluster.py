@@ -185,7 +185,10 @@ async def _test_nodedown_purges_remote_routes():
         await settle(clusters)
         assert "gone/+" in b0.router.topics()
         await clusters[1].stop()   # n1 dies
-        await asyncio.sleep(0.5)   # > heartbeat * max_missed
+        for _ in range(60):        # poll past heartbeat * max_missed
+            await asyncio.sleep(0.1)
+            if not clusters[0].membership.is_running("n1@127.0.0.1"):
+                break
         assert not clusters[0].membership.is_running("n1@127.0.0.1")
         assert "gone/+" not in b0.router.topics()
     finally:
@@ -473,5 +476,37 @@ async def _test_heartbeat_view_merge_heals_asymmetry():
             if victim in clusters[0].membership.members:
                 break
         assert victim in clusters[0].membership.members
+    finally:
+        await teardown(clusters)
+
+
+def test_mgmt_cluster_fanout(loop):
+    run(loop, _test_mgmt_cluster_fanout())
+
+
+async def _test_mgmt_cluster_fanout():
+    """emqx_mgmt list_* fan-out: one API node sees clients/subs everywhere."""
+    from emqx_tpu.mgmt import Mgmt
+    nodes, clusters = await make_cluster(2)
+    try:
+        m0 = Mgmt(nodes[0], clusters[0])
+        Mgmt(nodes[1], clusters[1])   # registers rpc handlers on n1
+        nodes[1].cm.register_channel("remote-c", object(),
+                                     {"username": "ru"})
+        b1 = nodes[1].broker
+        sid = b1.register(Capture(), "remote-c")
+        b1.subscribe(sid, "fan/+")
+        await settle(clusters)
+        infos = await m0.list_nodes()
+        assert {i["node"] for i in infos} == {"n0@127.0.0.1",
+                                             "n1@127.0.0.1"}
+        clients = await m0.list_clients()
+        assert any(c["clientid"] == "remote-c"
+                   and c["node"] == "n1@127.0.0.1" for c in clients)
+        subs = await m0.list_subscriptions()
+        assert any(s["topic"] == "fan/+" for s in subs)
+        routes = m0.list_routes()
+        assert any(r["topic"] == "fan/+"
+                   and r["node"] == ["n1@127.0.0.1"] for r in routes)
     finally:
         await teardown(clusters)

@@ -1,0 +1,370 @@
+"""Management REST API + CLI tests.
+
+Mirrors the reference's emqx_mgmt_api_SUITE / emqx_mgmt_cli coverage: the
+API is exercised over real HTTP sockets against a live broker with real
+MQTT clients; the CLI via its dispatch."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.mgmt import Cli, Mgmt, make_api
+from emqx_tpu.mgmt.apps import AppAuth
+from emqx_tpu.rules import RuleEngine
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 30))
+
+
+async def http(port, method, path, body=None, auth=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    data = json.dumps(body).encode() if body is not None else b""
+    hdrs = [f"{method} {path} HTTP/1.1", "host: x",
+            f"content-length: {len(data)}", "connection: close"]
+    if auth:
+        tok = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
+        hdrs.append(f"authorization: Basic {tok}")
+    writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + data)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, json.loads(payload) if payload else None
+
+
+@pytest.fixture()
+def stack(loop):
+    """Live broker + listener + rule engine + REST api + cli."""
+    node = Node(use_device=False)
+    RuleEngine(node).load()
+    listener = Listener(node, bind="127.0.0.1", port=0)
+    node.listeners.append(listener)
+    api = make_api(node, port=0)
+    loop.run_until_complete(listener.start())
+    loop.run_until_complete(api.start())
+    cli = Cli(node)
+    yield node, listener, api, cli
+    loop.run_until_complete(api.stop())
+    loop.run_until_complete(listener.stop())
+
+
+class TestRestApi:
+    def test_status_nodes_brokers(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, body = await http(api.port, "GET", "/status")
+            assert st == 200 and body["status"] == "running"
+            st, body = await http(api.port, "GET", "/api/v5/nodes")
+            assert st == 200 and body[0]["node"] == node.name
+            st, body = await http(api.port, "GET", "/api/v5/brokers")
+            assert st == 200 and body[0]["version"]
+        run(loop, go())
+
+    def test_clients_lifecycle(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="api-c1", username="u1")
+            await c.connect()
+            await c.subscribe("t/1", qos=1)
+            st, body = await http(api.port, "GET", "/api/v5/clients")
+            assert st == 200
+            ids = [x["clientid"] for x in body["data"]]
+            assert "api-c1" in ids
+            st, one = await http(api.port, "GET", "/api/v5/clients/api-c1")
+            assert st == 200 and one["clientid"] == "api-c1"
+            st, subs = await http(api.port, "GET",
+                                  "/api/v5/clients/api-c1/subscriptions")
+            assert st == 200 and subs[0]["topic"] == "t/1"
+            # kick over the API
+            st, _b = await http(api.port, "DELETE",
+                                "/api/v5/clients/api-c1")
+            assert st == 204
+            await asyncio.sleep(0.1)
+            st, _b = await http(api.port, "GET", "/api/v5/clients/api-c1")
+            assert st == 404
+        run(loop, go())
+
+    def test_subscriptions_routes(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="api-c2")
+            await c.connect()
+            await c.subscribe("r/+/x", qos=2)
+            st, body = await http(api.port, "GET", "/api/v5/subscriptions")
+            assert st == 200
+            assert any(s["topic"] == "r/+/x" and s["qos"] == 2
+                       for s in body["data"])
+            st, body = await http(api.port, "GET", "/api/v5/routes")
+            assert any(r["topic"] == "r/+/x" for r in body["data"])
+            st, one = await http(api.port, "GET", "/api/v5/routes/r%2F%2B%2Fx")
+            assert st == 200 and one["topic"] == "r/+/x"
+            await c.disconnect()
+        run(loop, go())
+
+    def test_publish_api_delivers(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="api-c3")
+            await c.connect()
+            await c.subscribe("api/pub", qos=1)
+            st, body = await http(api.port, "POST", "/api/v5/mqtt/publish",
+                                  {"topic": "api/pub", "payload": "hi",
+                                   "qos": 1})
+            assert st == 200 and body["deliveries"] == 1
+            m = await c.recv(timeout=5)
+            assert m.payload == b"hi"
+            # base64 payload
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/publish",
+                               {"topic": "api/pub",
+                                "payload": base64.b64encode(b"\x00\x01")
+                                .decode(), "encoding": "base64"})
+            m = await c.recv(timeout=5)
+            assert m.payload == b"\x00\x01"
+            await c.disconnect()
+        run(loop, go())
+
+    def test_mqtt_subscribe_api(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="api-c4")
+            await c.connect()
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/subscribe",
+                               {"clientid": "api-c4", "topic": "mgmt/sub",
+                                "qos": 1})
+            assert st == 200
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/publish",
+                               {"topic": "mgmt/sub", "payload": "x"})
+            m = await c.recv(timeout=5)
+            assert m.topic == "mgmt/sub"
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/unsubscribe",
+                               {"clientid": "api-c4", "topic": "mgmt/sub"})
+            assert st == 200
+            await c.disconnect()
+        run(loop, go())
+
+    def test_banned_api(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, _ = await http(api.port, "POST", "/api/v5/banned",
+                               {"as": "clientid", "who": "evil",
+                                "seconds": 60})
+            assert st == 201
+            st, body = await http(api.port, "GET", "/api/v5/banned")
+            assert body["data"][0]["who"] == "evil"
+            st, _ = await http(api.port, "DELETE",
+                               "/api/v5/banned/clientid/evil")
+            assert st == 204
+            st, _ = await http(api.port, "POST", "/api/v5/banned",
+                               {"as": "nonsense", "who": "x"})
+            assert st == 400
+        run(loop, go())
+
+    def test_rules_api(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, rule = await http(api.port, "POST", "/api/v5/rules", {
+                "id": "r1", "sql": 'SELECT * FROM "t/#"',
+                "actions": [{"name": "do_nothing", "params": {}}]})
+            assert st == 201 and rule["id"] == "r1"
+            st, lst_ = await http(api.port, "GET", "/api/v5/rules")
+            assert len(lst_) == 1
+            st, _ = await http(api.port, "PUT", "/api/v5/rules/r1",
+                               {"enabled": False})
+            assert st == 200
+            assert node.rule_engine.get_rule("r1").enabled is False
+            st, out = await http(api.port, "POST", "/api/v5/rule_test", {
+                "sql": 'SELECT payload.a as a FROM "t"',
+                "context": {"topic": "t", "payload": '{"a": 5}'}})
+            assert out["outputs"] == [{"a": 5}]
+            st, _ = await http(api.port, "DELETE", "/api/v5/rules/r1")
+            assert st == 204
+            st, _ = await http(api.port, "POST", "/api/v5/rules",
+                               {"sql": "garbage", "actions": []})
+            assert st == 400
+        run(loop, go())
+
+    def test_metrics_stats_listeners(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, m = await http(api.port, "GET",
+                               "/api/v5/metrics?aggregate=true")
+            assert st == 200 and isinstance(m, dict)
+            st, s = await http(api.port, "GET", "/api/v5/stats")
+            assert st == 200 and s[0]["node"] == node.name
+            st, ls = await http(api.port, "GET", "/api/v5/listeners")
+            assert st == 200 and ls[0]["bind"].endswith(str(lst.port))
+        run(loop, go())
+
+    def test_pagination(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            for i in range(5):
+                node.broker.subscribe(
+                    node.broker.register(object(), f"pg{i}"), f"pg/{i}")
+            st, body = await http(api.port, "GET",
+                                  "/api/v5/routes?_page=2&_limit=2")
+            assert body["meta"]["count"] == 5
+            assert len(body["data"]) == 2
+        run(loop, go())
+
+
+class TestAuth:
+    def test_basic_auth_required(self, loop):
+        node = Node(use_device=False)
+        auth = AppAuth()
+        secret = auth.add_app("app1", "test app")
+        api = make_api(node, app_auth=auth, port=0)
+        run(loop, api.start())
+        try:
+            async def go():
+                st, _ = await http(api.port, "GET", "/api/v5/nodes")
+                assert st == 401
+                st, _ = await http(api.port, "GET", "/api/v5/nodes",
+                                   auth=("app1", "wrong"))
+                assert st == 401
+                st, body = await http(api.port, "GET", "/api/v5/nodes",
+                                      auth=("app1", secret))
+                assert st == 200
+                # status stays open (health checks)
+                st, _ = await http(api.port, "GET", "/status")
+                assert st == 200
+            run(loop, go())
+        finally:
+            run(loop, api.stop())
+
+    def test_app_crud(self):
+        auth = AppAuth()
+        s = auth.add_app("a", "A")
+        assert auth.is_authorized("a", s)
+        assert not auth.is_authorized("a", "nope")
+        auth.update_app("a", False)
+        assert not auth.is_authorized("a", s)
+        assert auth.lookup_app("a")["status"] is False
+        assert "secret" not in auth.lookup_app("a")
+        assert auth.del_app("a") and not auth.del_app("a")
+
+
+class TestCli:
+    def test_status_broker(self, loop, stack):
+        node, lst, api, cli = stack
+        out = run(loop, cli.run(["status"]))
+        assert "is running" in out
+        out = run(loop, cli.run(["broker"]))
+        assert "version" in out
+        out = run(loop, cli.run(["broker", "stats"]))
+        assert "connections.count" in out
+        out = run(loop, cli.run(["broker", "metrics"]))
+        assert "messages.publish" in out
+
+    def test_clients_routes_subs(self, loop, stack):
+        node, lst, api, cli = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="cli-c1")
+            await c.connect()
+            await c.subscribe("cli/t", qos=0)
+            out = await cli.run(["clients", "list"])
+            assert "cli-c1" in out
+            out = await cli.run(["subscriptions", "show", "cli-c1"])
+            assert "cli/t" in out
+            out = await cli.run(["routes", "list"])
+            assert "cli/t" in out
+            out = await cli.run(["subscriptions", "add", "cli-c1",
+                                 "cli/added", "1"])
+            assert out == "ok"
+            out = await cli.run(["clients", "kick", "cli-c1"])
+            assert out == "ok"
+        run(loop, go())
+
+    def test_banned_rules_usage(self, loop, stack):
+        node, lst, api, cli = stack
+        out = run(loop, cli.run(["banned", "add", "clientid", "bad", "60"]))
+        assert out == "ok"
+        out = run(loop, cli.run(["banned", "list"]))
+        assert "bad" in out
+        out = run(loop, cli.run(["rules", "list"]))
+        assert out == "(none)"
+        out = run(loop, cli.run(["nonsense"]))
+        assert "unknown command" in out
+        out = run(loop, cli.run(["clients", "bogus-sub"]))
+        assert "clients list" in out     # usage text
+
+
+class TestApiHardening:
+    def test_bad_rule_update_preserves_rule(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            await http(api.port, "POST", "/api/v5/rules", {
+                "id": "keep", "sql": 'SELECT * FROM "k/#"',
+                "actions": [{"name": "do_nothing", "params": {}}]})
+            st, _ = await http(api.port, "PUT", "/api/v5/rules/keep",
+                               {"sql": "garbage sql"})
+            assert st == 400
+            assert node.rule_engine.get_rule("keep") is not None
+            assert node.rule_engine.get_rule("keep").sql \
+                == 'SELECT * FROM "k/#"'
+        run(loop, go())
+
+    def test_missing_fields_are_400(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, _ = await http(api.port, "POST", "/api/v5/banned",
+                               {"as": "clientid"})  # no "who"
+            assert st == 400
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/publish",
+                               {"payload": "x"})    # no topic
+            assert st == 400
+        run(loop, go())
+
+    def test_subscribe_invalid_topic_is_400_not_404(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            c = Client(port=lst.port, clientid="h-c1")
+            await c.connect()
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/subscribe",
+                               {"clientid": "h-c1", "topic": "a/#/b"})
+            assert st == 400
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/subscribe",
+                               {"clientid": "ghost", "topic": "ok/t"})
+            assert st == 404
+            await c.disconnect()
+        run(loop, go())
+
+    def test_malformed_content_length(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            r, w = await asyncio.open_connection("127.0.0.1", api.port)
+            w.write(b"GET /status HTTP/1.1\r\nhost: x\r\n"
+                    b"content-length: abc\r\n\r\n")
+            await w.drain()
+            raw = await r.read(-1)
+            assert b"400" in raw.split(b"\r\n")[0]
+            w.close()
+        run(loop, go())
