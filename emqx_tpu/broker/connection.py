@@ -224,14 +224,16 @@ class Listener:
                  self.port)
 
     async def stop(self) -> None:
-        # cancel live connections BEFORE wait_closed: py3.12 wait_closed
-        # blocks until every handler coroutine finishes
+        # stop accepting first so no connection slips in during the cancel
+        # window; then cancel handlers (py3.12 wait_closed blocks until
+        # every handler coroutine finishes, so cancel before waiting)
+        if self._server:
+            self._server.close()
         for t in list(self._conns):
             t.cancel()
         if self._conns:
             await asyncio.gather(*self._conns, return_exceptions=True)
         if self._server:
-            self._server.close()
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 2)
             except asyncio.TimeoutError:

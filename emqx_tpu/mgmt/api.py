@@ -242,13 +242,19 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         if "enabled" in body:
             eng.enable_rule(r.id, bool(body["enabled"]))
         if "sql" in body or "actions" in body or "description" in body:
-            # validate the new SQL BEFORE touching the existing rule so a
+            # validate EVERYTHING before touching the existing rule so a
             # bad update can never destroy a working rule
             from emqx_tpu.rules.sqlparser import parse_sql
             try:
                 parse_sql(body.get("sql", r.sql))
             except Exception as e:  # noqa: BLE001
                 raise ApiError(400, "BAD_SQL", str(e))
+            actions = body.get("actions", r.actions)
+            if not (isinstance(actions, list) and
+                    all(isinstance(a, dict) and "name" in a
+                        for a in actions)):
+                raise ApiError(400, "BAD_REQUEST",
+                               "actions must be a list of {name, params}")
             enabled = r.enabled
             eng.delete_rule(r.id)
             r = eng.create_rule(body.get("sql", r.sql),

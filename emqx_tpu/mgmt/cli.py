@@ -42,7 +42,8 @@ class Cli:
             return f"unknown command {argv[0]!r}\n" + self.usage()
         try:
             return await cmd[0](argv[1:])
-        except _Usage:
+        except (_Usage, ValueError):
+            # bad numeric args etc. print the usage line, not a traceback
             return cmd[1]
 
     def usage(self) -> str:
@@ -177,7 +178,7 @@ class Cli:
             out.append(f"{getattr(l, 'protocol', 'mqtt:tcp')} on "
                        f"{getattr(l, 'bind', '0.0.0.0')}:"
                        f"{getattr(l, 'port', 0)}\n"
-                       f"  current_conn: {getattr(l, 'conn_count', 0)}")
+                       f"  current_conn: {getattr(l, 'current_conns', 0)}")
         return "\n".join(out) or "(none)"
 
     async def _vm(self, _args) -> str:

@@ -180,7 +180,7 @@ class HttpServer:
                 res = await res
         except json.JSONDecodeError:
             return 400, {"code": "BAD_REQUEST", "message": "invalid json"}
-        except (KeyError, TypeError) as e:
+        except (KeyError, TypeError, ValueError) as e:
             # missing/mistyped body fields are client errors, not 500s
             return 400, {"code": "BAD_REQUEST",
                          "message": f"missing or invalid field: {e}"}

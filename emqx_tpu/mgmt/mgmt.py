@@ -126,25 +126,22 @@ class Mgmt:
     async def list_brokers(self) -> list[dict]:
         return list((await self._fanout("mgmt.broker_info", [])).values())
 
-    async def stats(self, aggregate: bool = False) -> Any:
-        per = await self._fanout("mgmt.stats", [])
+    async def _per_node_counters(self, fn: str, aggregate: bool) -> Any:
+        per = await self._fanout(fn, [])
         if not aggregate:
             return [{"node": n, **v} for n, v in per.items()]
         agg: dict = {}
         for v in per.values():
             for k, x in v.items():
-                agg[k] = agg.get(k, 0) + x
+                if isinstance(x, (int, float)):
+                    agg[k] = agg.get(k, 0) + x
         return agg
 
+    async def stats(self, aggregate: bool = False) -> Any:
+        return await self._per_node_counters("mgmt.stats", aggregate)
+
     async def metrics(self, aggregate: bool = False) -> Any:
-        per = await self._fanout("mgmt.metrics", [])
-        if not aggregate:
-            return [{"node": n, **v} for n, v in per.items()]
-        agg: dict = {}
-        for v in per.values():
-            for k, x in v.items():
-                agg[k] = agg.get(k, 0) + x
-        return agg
+        return await self._per_node_counters("mgmt.metrics", aggregate)
 
     async def list_clients(self) -> list[dict]:
         out: list[dict] = []
@@ -195,6 +192,12 @@ class Mgmt:
     def publish(self, topic: str, payload: bytes, qos: int = 0,
                 retain: bool = False, clientid: str = "http_api",
                 properties: Optional[dict] = None) -> int:
+        from emqx_tpu.utils import topic as T
+        try:
+            # same topic-NAME validation the MQTT PUBLISH path enforces
+            T.validate(topic, "name")
+        except T.TopicError as e:
+            raise ValueError(f"invalid topic name: {e}") from e
         msg = make(clientid, qos, topic, payload,
                    flags={"retain": retain},
                    headers={"properties": properties or {}})

@@ -1,0 +1,662 @@
+"""Gateway tests: STOMP, MQTT-SN, CoAP, LwM2M over real sockets.
+
+Mirrors the reference's per-gateway suites (emqx_stomp_SUITE,
+emqx_sn_protocol_SUITE, emqx_coap_SUITE, emqx_lwm2m_SUITE) plus the C
+wire-level MQTT-SN clients (apps/emqx_gateway/test/intergration_test)."""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.node import Node
+from emqx_tpu.gateway import coap as CO
+from emqx_tpu.gateway import mqttsn as SN
+from emqx_tpu.gateway.lwm2m import (Lwm2mGateway, tlv_decode, tlv_encode)
+from emqx_tpu.gateway.stomp import Frame, FrameParser, StompGateway
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 20))
+
+
+class Capture:
+    def __init__(self):
+        self.msgs = []
+
+    def deliver(self, f, m):
+        self.msgs.append(m)
+        return True
+
+
+# ---------- STOMP ----------
+
+class TestStompFrame:
+    def test_roundtrip(self):
+        f = Frame("SEND", {"destination": "/t", "a:b": "x\ny"}, b"hello")
+        p = FrameParser()
+        [g] = p.feed(f.encode())
+        assert g.command == "SEND" and g.body == b"hello"
+        assert g.headers["destination"] == "/t"
+        assert g.headers["a:b"] == "x\ny"   # header escaping survived
+
+    def test_partial_feed_and_multiple(self):
+        f1 = Frame("CONNECT", {"login": "u"}).encode()
+        f2 = Frame("SEND", {"destination": "d"}, b"B").encode()
+        p = FrameParser()
+        data = f1 + b"\n" + f2        # heart-beat newline between frames
+        got = []
+        for i in range(0, len(data), 7):
+            got += p.feed(data[i:i + 7])
+        assert [g.command for g in got] == ["CONNECT", "SEND"]
+
+    def test_content_length_binary_body(self):
+        f = Frame("SEND", {"destination": "d",
+                           "content-length": "3"}, b"\x00\x01\x02")
+        [g] = FrameParser().feed(f.encode())
+        assert g.body == b"\x00\x01\x02"
+
+
+class StompClient:
+    def __init__(self, port):
+        self.port = port
+        self.parser = FrameParser()
+        self.frames = asyncio.Queue()
+
+    async def connect(self, headers=None):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        self._rx = asyncio.ensure_future(self._rx_loop())
+        await self.send(Frame("CONNECT", headers or
+                              {"accept-version": "1.2", "login": "guest"}))
+        f = await self.recv()
+        assert f.command == "CONNECTED", f.command
+        return f
+
+    async def _rx_loop(self):
+        while True:
+            data = await self.reader.read(4096)
+            if not data:
+                return
+            for f in self.parser.feed(data):
+                self.frames.put_nowait(f)
+
+    async def send(self, frame):
+        self.writer.write(frame.encode())
+        await self.writer.drain()
+
+    async def recv(self, timeout=5):
+        return await asyncio.wait_for(self.frames.get(), timeout)
+
+    def close(self):
+        self._rx.cancel()
+        self.writer.close()
+
+
+@pytest.fixture()
+def stomp(loop):
+    node = Node(use_device=False)
+    gw = StompGateway(node, {"port": 0})
+    loop.run_until_complete(gw.start())
+    yield node, gw
+    loop.run_until_complete(gw.stop())
+
+
+class TestStompGateway:
+    def test_connect_send_subscribe(self, loop, stomp):
+        node, gw = stomp
+
+        async def go():
+            a = StompClient(gw.port)
+            b = StompClient(gw.port)
+            await a.connect()
+            await b.connect()
+            await b.send(Frame("SUBSCRIBE", {"id": "s1",
+                                             "destination": "st/+",
+                                             "receipt": "r1"}))
+            r = await b.recv()
+            assert r.command == "RECEIPT" and r.headers["receipt-id"] == "r1"
+            # stomp -> stomp
+            await a.send(Frame("SEND", {"destination": "st/x"}, b"hi"))
+            m = await b.recv()
+            assert m.command == "MESSAGE" and m.body == b"hi"
+            assert m.headers["destination"] == "st/x"
+            assert m.headers["subscription"] == "s1"
+            # core mqtt -> stomp
+            from emqx_tpu.broker.message import make
+            node.broker.publish(make("mq", 0, "st/y", b"from-mqtt"))
+            m = await b.recv()
+            assert m.body == b"from-mqtt"
+            # stomp -> core mqtt
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "st/#")
+            await a.send(Frame("SEND", {"destination": "st/z"}, b"out"))
+            await asyncio.sleep(0.1)
+            assert any(m.payload == b"out" for m in cap.msgs)
+            a.close()
+            b.close()
+        run(loop, go())
+
+    def test_transactions(self, loop, stomp):
+        node, gw = stomp
+
+        async def go():
+            a = StompClient(gw.port)
+            await a.connect()
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "tx/#")
+            await a.send(Frame("BEGIN", {"transaction": "t1"}))
+            await a.send(Frame("SEND", {"destination": "tx/1",
+                                        "transaction": "t1"}, b"a"))
+            await a.send(Frame("SEND", {"destination": "tx/2",
+                                        "transaction": "t1"}, b"b"))
+            await asyncio.sleep(0.1)
+            assert cap.msgs == []          # buffered until COMMIT
+            await a.send(Frame("COMMIT", {"transaction": "t1",
+                                          "receipt": "rc"}))
+            await a.recv()
+            await asyncio.sleep(0.1)
+            assert sorted(m.payload for m in cap.msgs) == [b"a", b"b"]
+            # abort drops
+            await a.send(Frame("BEGIN", {"transaction": "t2"}))
+            await a.send(Frame("SEND", {"destination": "tx/3",
+                                        "transaction": "t2"}, b"c"))
+            await a.send(Frame("ABORT", {"transaction": "t2"}))
+            await asyncio.sleep(0.1)
+            assert len(cap.msgs) == 2
+            a.close()
+        run(loop, go())
+
+    def test_error_before_connect(self, loop, stomp):
+        node, gw = stomp
+
+        async def go():
+            c = StompClient(gw.port)
+            c.reader, c.writer = await asyncio.open_connection(
+                "127.0.0.1", gw.port)
+            c._rx = asyncio.ensure_future(c._rx_loop())
+            await c.send(Frame("SEND", {"destination": "x"}, b""))
+            f = await c.recv()
+            assert f.command == "ERROR"
+            c.close()
+        run(loop, go())
+
+    def test_unsubscribe_stops_delivery(self, loop, stomp):
+        node, gw = stomp
+
+        async def go():
+            from emqx_tpu.broker.message import make
+            a = StompClient(gw.port)
+            await a.connect()
+            await a.send(Frame("SUBSCRIBE", {"id": "1",
+                                             "destination": "u/t"}))
+            await asyncio.sleep(0.05)
+            node.broker.publish(make("m", 0, "u/t", b"1"))
+            assert (await a.recv()).body == b"1"
+            await a.send(Frame("UNSUBSCRIBE", {"id": "1",
+                                               "receipt": "r"}))
+            await a.recv()
+            node.broker.publish(make("m", 0, "u/t", b"2"))
+            with pytest.raises(asyncio.TimeoutError):
+                await a.recv(timeout=0.3)
+            a.close()
+        run(loop, go())
+
+
+# ---------- MQTT-SN ----------
+
+class SnTestClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(SN.decode(data))
+
+    @classmethod
+    async def create(cls, port):
+        loop = asyncio.get_running_loop()
+        proto = cls()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: proto, remote_addr=("127.0.0.1", port))
+        proto.transport = transport
+        return proto
+
+    def send(self, msg_type, body=b""):
+        self.transport.sendto(SN.encode(msg_type, body))
+
+    async def recv(self, timeout=5):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    async def connect(self, clientid=b"dev1", flags=0):
+        self.send(SN.CONNECT, bytes([flags, 1]) +
+                  struct.pack(">H", 60) + clientid)
+        t, body = await self.recv()
+        assert t == SN.CONNACK and body[0] == 0, (t, body)
+
+
+@pytest.fixture()
+def sn(loop):
+    node = Node(use_device=False)
+    gw = SN.MqttSnGateway(node, {"port": 0,
+                                 "predefined": {10: "pre/defined"}})
+    loop.run_until_complete(gw.start())
+    yield node, gw
+    loop.run_until_complete(gw.stop())
+
+
+class TestMqttSn:
+    def test_searchgw(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            c = await SnTestClient.create(gw.port)
+            c.send(SN.SEARCHGW, b"\x01")
+            t, body = await c.recv()
+            assert t == SN.GWINFO and body[0] == gw.gw_id
+        run(loop, go())
+
+    def test_connect_register_publish_qos1(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "sn/#")
+            c = await SnTestClient.create(gw.port)
+            await c.connect()
+            # REGISTER topic alias
+            c.send(SN.REGISTER, struct.pack(">HH", 0, 1) + b"sn/data")
+            t, body = await c.recv()
+            assert t == SN.REGACK
+            tid, mid = struct.unpack(">HH", body[:4])
+            assert body[4] == 0 and mid == 1
+            # PUBLISH QoS1 with the alias
+            c.send(SN.PUBLISH, bytes([0x20]) + struct.pack(">H", tid) +
+                   struct.pack(">H", 7) + b"val")
+            t, body = await c.recv()
+            assert t == SN.PUBACK and body[4] == 0
+            await asyncio.sleep(0.05)
+            assert cap.msgs[0].payload == b"val"
+            assert cap.msgs[0].topic == "sn/data"
+            assert cap.msgs[0].qos == 1
+        run(loop, go())
+
+    def test_subscribe_wildcard_and_deliver_registers_alias(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            from emqx_tpu.broker.message import make
+            c = await SnTestClient.create(gw.port)
+            await c.connect(b"sub1")
+            c.send(SN.SUBSCRIBE, bytes([0x20]) + struct.pack(">H", 2) +
+                   b"room/+/temp")
+            t, body = await c.recv()
+            assert t == SN.SUBACK and body[-1] == 0
+            node.broker.publish(make("m", 1, "room/7/temp", b"20"))
+            # unseen topic: gateway must REGISTER the alias first
+            t, body = await c.recv()
+            assert t == SN.REGISTER
+            tid = struct.unpack(">H", body[:2])[0]
+            assert body[4:] == b"room/7/temp"
+            t, body = await c.recv()
+            assert t == SN.PUBLISH
+            assert struct.unpack(">H", body[1:3])[0] == tid
+            assert body[5:] == b"20"
+        run(loop, go())
+
+    def test_qos_minus1_predefined(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "pre/defined")
+            c = await SnTestClient.create(gw.port)
+            # no CONNECT; QoS -1 (flags 0b011) with predefined topic id 10
+            c.send(SN.PUBLISH, bytes([0x61]) + struct.pack(">H", 10) +
+                   struct.pack(">H", 0) + b"fire-and-forget")
+            await asyncio.sleep(0.1)
+            assert cap.msgs[0].payload == b"fire-and-forget"
+        run(loop, go())
+
+    def test_sleep_buffer_pingreq_drain(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            from emqx_tpu.broker.message import make
+            c = await SnTestClient.create(gw.port)
+            await c.connect(b"sleepy")
+            c.send(SN.SUBSCRIBE, bytes([0]) + struct.pack(">H", 3) +
+                   b"zzz/t")
+            await c.recv()
+            c.send(SN.DISCONNECT, struct.pack(">H", 30))   # sleep 30s
+            t, _ = await c.recv()
+            assert t == SN.DISCONNECT
+            node.broker.publish(make("m", 0, "zzz/t", b"while-asleep"))
+            await asyncio.sleep(0.1)
+            assert c.inbox.empty()            # buffered, not sent
+            c.send(SN.PINGREQ, b"sleepy")     # wake
+            msgs = [await c.recv(), await c.recv()]
+            types = {t for t, _ in msgs}
+            assert SN.PINGRESP in types
+            pub = next(b for t, b in msgs if t == SN.PUBLISH)
+            assert pub[5:] == b"while-asleep"
+        run(loop, go())
+
+    def test_qos2_publish(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "q2/t")
+            c = await SnTestClient.create(gw.port)
+            await c.connect(b"q2dev")
+            c.send(SN.REGISTER, struct.pack(">HH", 0, 1) + b"q2/t")
+            _, body = await c.recv()
+            tid = struct.unpack(">H", body[:2])[0]
+            c.send(SN.PUBLISH, bytes([0x40]) + struct.pack(">H", tid) +
+                   struct.pack(">H", 9) + b"exactly-once")
+            t, body = await c.recv()
+            assert t == SN.PUBREC
+            assert cap.msgs == []             # held until PUBREL
+            c.send(SN.PUBREL, struct.pack(">H", 9))
+            t, _ = await c.recv()
+            assert t == SN.PUBCOMP
+            await asyncio.sleep(0.05)
+            assert cap.msgs[0].payload == b"exactly-once"
+        run(loop, go())
+
+    def test_will_flow(self, loop, sn):
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "will/t")
+            c = await SnTestClient.create(gw.port)
+            c.send(SN.CONNECT, bytes([SN.FLAG_WILL, 1]) +
+                   struct.pack(">H", 60) + b"willdev")
+            t, _ = await c.recv()
+            assert t == SN.WILLTOPICREQ
+            c.send(SN.WILLTOPIC, bytes([0]) + b"will/t")
+            t, _ = await c.recv()
+            assert t == SN.WILLMSGREQ
+            c.send(SN.WILLMSG, b"gone")
+            t, body = await c.recv()
+            assert t == SN.CONNACK and body[0] == 0
+            # plain DISCONNECT publishes the will in MQTT-SN (no clean flag)
+            c.send(SN.DISCONNECT)
+            await c.recv()
+            await asyncio.sleep(0.05)
+            assert cap.msgs[0].payload == b"gone"
+        run(loop, go())
+
+
+# ---------- CoAP ----------
+
+class CoapTestClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(CO.decode(data))
+
+    @classmethod
+    async def create(cls, port):
+        loop = asyncio.get_running_loop()
+        proto = cls()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: proto, remote_addr=("127.0.0.1", port))
+        proto.transport = transport
+        return proto
+
+    def send(self, msg):
+        self.transport.sendto(CO.encode(msg))
+
+    async def recv(self, timeout=5):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+
+def _mqtt_req(code, topic, mid, token=b"\x01", observe=None,
+              payload=b"", query=()):
+    opts = [(CO.OPT_URI_PATH, b"mqtt")]
+    opts += [(CO.OPT_URI_PATH, seg.encode()) for seg in topic.split("/")]
+    opts += [(CO.OPT_URI_QUERY, q.encode()) for q in query]
+    if observe is not None:
+        opts.append((CO.OPT_OBSERVE, b"" if observe == 0 else b"\x01"))
+    return CO.CoapMessage(type=CO.CON, code=code, message_id=mid,
+                          token=token, options=opts, payload=payload)
+
+
+class TestCoapCodec:
+    def test_roundtrip_with_ext_options(self):
+        m = CO.CoapMessage(type=CO.CON, code=CO.PUT, message_id=0x1234,
+                           token=b"\xAA\xBB",
+                           options=[(CO.OPT_URI_PATH, b"mqtt"),
+                                    (CO.OPT_URI_QUERY, b"c=dev"),
+                                    (2048, b"x" * 300)],
+                           payload=b"data")
+        d = CO.decode(CO.encode(m))
+        assert d.code == CO.PUT and d.message_id == 0x1234
+        assert d.token == b"\xAA\xBB" and d.payload == b"data"
+        assert d.opt(2048) == b"x" * 300
+        assert d.uri_path == ["mqtt"]
+
+    def test_bad_version_rejected(self):
+        with pytest.raises(CO.CoapError):
+            CO.decode(b"\x00\x01\x00\x01")
+
+
+@pytest.fixture()
+def coap(loop):
+    node = Node(use_device=False)
+    gw = CO.CoapGateway(node, {"port": 0})
+    loop.run_until_complete(gw.start())
+    yield node, gw
+    loop.run_until_complete(gw.stop())
+
+
+class TestCoapGateway:
+    def test_put_publishes(self, loop, coap):
+        node, gw = coap
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "co/data")
+            c = await CoapTestClient.create(gw.port)
+            c.send(_mqtt_req(CO.PUT, "co/data", 1, query=("c=dev1",),
+                             payload=b"21C"))
+            r = await c.recv()
+            assert r.code == CO.CHANGED and r.type == CO.ACK
+            await asyncio.sleep(0.05)
+            assert cap.msgs[0].payload == b"21C"
+            assert cap.msgs[0].topic == "co/data"
+        run(loop, go())
+
+    def test_observe_subscription(self, loop, coap):
+        node, gw = coap
+
+        async def go():
+            from emqx_tpu.broker.message import make
+            c = await CoapTestClient.create(gw.port)
+            c.send(_mqtt_req(CO.GET, "co/obs", 2, token=b"\x42",
+                             observe=0, query=("c=watcher",)))
+            r = await c.recv()
+            assert r.code == CO.CONTENT
+            node.broker.publish(make("m", 0, "co/obs", b"notif-1"))
+            n = await c.recv()
+            assert n.payload == b"notif-1" and n.token == b"\x42"
+            assert n.opt(CO.OPT_OBSERVE) is not None
+            # deregister
+            c.send(_mqtt_req(CO.GET, "co/obs", 3, token=b"\x42",
+                             observe=1, query=("c=watcher",)))
+            await c.recv()
+            node.broker.publish(make("m", 0, "co/obs", b"notif-2"))
+            with pytest.raises(asyncio.TimeoutError):
+                await c.recv(timeout=0.3)
+        run(loop, go())
+
+    def test_not_found_outside_mqtt(self, loop, coap):
+        node, gw = coap
+
+        async def go():
+            c = await CoapTestClient.create(gw.port)
+            c.send(CO.CoapMessage(type=CO.CON, code=CO.GET, message_id=9,
+                                  token=b"\x01",
+                                  options=[(CO.OPT_URI_PATH, b"other")]))
+            r = await c.recv()
+            assert r.code == CO.NOT_FOUND
+        run(loop, go())
+
+
+# ---------- LwM2M ----------
+
+class TestTlv:
+    def test_roundtrip_nested(self):
+        entries = [{"kind": "obj_inst", "id": 0, "value": [
+            {"kind": "resource", "id": 0, "value": b"Open Mobile"},
+            {"kind": "resource", "id": 1, "value": b"LWM2M-1"},
+            {"kind": "multi_res", "id": 6, "value": [
+                {"kind": "res_inst", "id": 0, "value": b"\x01"},
+                {"kind": "res_inst", "id": 1, "value": b"\x05"}]},
+        ]}]
+        out = tlv_decode(tlv_encode(entries))
+        assert out[0]["kind"] == "obj_inst"
+        inner = out[0]["value"]
+        assert inner[0]["value"] == b"Open Mobile"
+        assert inner[2]["value"][1]["value"] == b"\x05"
+
+    def test_long_value_and_wide_id(self):
+        entries = [{"kind": "resource", "id": 300, "value": b"z" * 700}]
+        [e] = tlv_decode(tlv_encode(entries))
+        assert e["id"] == 300 and len(e["value"]) == 700
+
+
+@pytest.fixture()
+def lwm2m(loop):
+    node = Node(use_device=False)
+    gw = Lwm2mGateway(node, {"port": 0})
+    loop.run_until_complete(gw.start())
+    yield node, gw
+    loop.run_until_complete(gw.stop())
+
+
+def _rd_register(ep, mid=1):
+    return CO.CoapMessage(
+        type=CO.CON, code=CO.POST, message_id=mid, token=b"\x07",
+        options=[(CO.OPT_URI_PATH, b"rd"),
+                 (CO.OPT_URI_QUERY, f"ep={ep}".encode()),
+                 (CO.OPT_URI_QUERY, b"lt=120"),
+                 (CO.OPT_URI_QUERY, b"lwm2m=1.0")],
+        payload=b"</1/0>,</3/0>")
+
+
+class TestLwm2m:
+    def test_register_update_deregister(self, loop, lwm2m):
+        node, gw = lwm2m
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "lwm2m/+/up/#")
+            dev = await CoapTestClient.create(gw.port)
+            dev.send(_rd_register("ep-1"))
+            r = await dev.recv()
+            assert r.code == CO.CREATED
+            loc = [v.decode() for v in r.opts(CO.OPT_LOCATION_PATH)]
+            assert loc[0] == "rd" and len(loc) == 2
+            await asyncio.sleep(0.05)
+            reg = json.loads(cap.msgs[0].payload)
+            assert reg["msgType"] == "register"
+            assert reg["data"]["objectList"] == ["/1/0", "/3/0"]
+            assert cap.msgs[0].topic == "lwm2m/ep-1/up/resp"
+            # update
+            dev.send(CO.CoapMessage(
+                type=CO.CON, code=CO.PUT, message_id=2, token=b"\x08",
+                options=[(CO.OPT_URI_PATH, b"rd"),
+                         (CO.OPT_URI_PATH, loc[1].encode()),
+                         (CO.OPT_URI_QUERY, b"lt=300")]))
+            r = await dev.recv()
+            assert r.code == CO.CHANGED
+            assert gw.sessions["ep-1"].lifetime == 300
+            # deregister
+            dev.send(CO.CoapMessage(
+                type=CO.CON, code=CO.DELETE, message_id=3, token=b"\x09",
+                options=[(CO.OPT_URI_PATH, b"rd"),
+                         (CO.OPT_URI_PATH, loc[1].encode())]))
+            r = await dev.recv()
+            assert r.code == CO.DELETED
+            assert "ep-1" not in gw.sessions
+        run(loop, go())
+
+    def test_downlink_read_roundtrip(self, loop, lwm2m):
+        node, gw = lwm2m
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "lwm2m/ep-2/up/resp")
+            dev = await CoapTestClient.create(gw.port)
+            dev.send(_rd_register("ep-2"))
+            await dev.recv()
+            await asyncio.sleep(0.05)
+            cap.msgs.clear()
+            # downlink read command over MQTT
+            node.broker.publish(__import__(
+                "emqx_tpu.broker.message", fromlist=["make"]).make(
+                "ctl", 0, "lwm2m/ep-2/dn/cmd", json.dumps({
+                    "reqID": 42, "msgType": "read",
+                    "data": {"path": "/3/0/0"}}).encode()))
+            req = await dev.recv()
+            assert req.code == CO.GET
+            assert req.uri_path == ["3", "0", "0"]
+            # device answers with TLV content
+            tlv = tlv_encode([{"kind": "resource", "id": 0,
+                               "value": b"ACME Corp"}])
+            dev.send(CO.CoapMessage(
+                type=CO.ACK, code=CO.CONTENT, message_id=req.message_id,
+                token=req.token,
+                options=[(CO.OPT_CONTENT_FORMAT,
+                          struct.pack(">H", 11542))],
+                payload=tlv))
+            await asyncio.sleep(0.1)
+            resp = json.loads(cap.msgs[0].payload)
+            assert resp["reqID"] == 42 and resp["msgType"] == "read"
+            assert resp["data"]["code"] == "2.05"
+            assert resp["data"]["content"][0]["value"] == "ACME Corp"
+        run(loop, go())
+
+    def test_downlink_write_and_execute(self, loop, lwm2m):
+        node, gw = lwm2m
+
+        async def go():
+            from emqx_tpu.broker.message import make
+            dev = await CoapTestClient.create(gw.port)
+            dev.send(_rd_register("ep-3"))
+            await dev.recv()
+            await asyncio.sleep(0.05)
+            node.broker.publish(make("ctl", 0, "lwm2m/ep-3/dn/cmd",
+                                     json.dumps({
+                                         "reqID": 1, "msgType": "write",
+                                         "data": {"path": "/3/0/15",
+                                                  "value": "UTC+2"}
+                                     }).encode()))
+            req = await dev.recv()
+            assert req.code == CO.PUT and req.payload == b"UTC+2"
+            node.broker.publish(make("ctl", 0, "lwm2m/ep-3/dn/cmd",
+                                     json.dumps({
+                                         "reqID": 2, "msgType": "execute",
+                                         "data": {"path": "/3/0/4",
+                                                  "args": "0"}
+                                     }).encode()))
+            req = await dev.recv()
+            assert req.code == CO.POST and req.uri_path == ["3", "0", "4"]
+        run(loop, go())

@@ -368,3 +368,32 @@ class TestApiHardening:
             assert b"400" in raw.split(b"\r\n")[0]
             w.close()
         run(loop, go())
+
+    def test_wildcard_topic_publish_rejected(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            st, _ = await http(api.port, "POST", "/api/v5/mqtt/publish",
+                               {"topic": "bad/+", "payload": "x"})
+            assert st == 400
+        run(loop, go())
+
+    def test_bad_actions_update_preserves_rule(self, loop, stack):
+        node, lst, api, _ = stack
+
+        async def go():
+            await http(api.port, "POST", "/api/v5/rules", {
+                "id": "keep2", "sql": 'SELECT * FROM "k/#"',
+                "actions": [{"name": "do_nothing", "params": {}}]})
+            st, _ = await http(api.port, "PUT", "/api/v5/rules/keep2",
+                               {"actions": 5})
+            assert st == 400
+            assert node.rule_engine.get_rule("keep2") is not None
+        run(loop, go())
+
+    def test_cli_bad_numeric_args_print_usage(self, loop, stack):
+        node, lst, api, cli = stack
+        out = run(loop, cli.run(["subscriptions", "add", "c", "t", "abc"]))
+        assert "subscriptions list" in out
+        out = run(loop, cli.run(["banned", "add", "clientid", "x", "zz"]))
+        assert "banned list" in out
