@@ -111,6 +111,14 @@ class CoapError(Exception):
 
 
 def decode(data: bytes) -> CoapMessage:
+    try:
+        return _decode(data)
+    except (struct.error, IndexError) as e:
+        # truncated datagrams surface as CoapError (silent drop upstream)
+        raise CoapError(f"truncated message: {e}") from e
+
+
+def _decode(data: bytes) -> CoapMessage:
     if len(data) < 4 or (data[0] >> 6) != 1:
         raise CoapError("bad version/short header")
     tkl = data[0] & 0x0F
@@ -272,6 +280,10 @@ class CoapGateway(asyncio.DatagramProtocol):
                                                 topic):
                     self._reply(addr, req, UNAUTHORIZED)
                     return
+                prev = self.observers.pop(key, None)
+                if prev is not None and prev.sid is not None:
+                    # retransmitted observe: the old registration must go
+                    self.ctx.unregister_subscriber(prev.sid)
                 ob = _Observer(self, addr, bytes(req.token), clientid,
                                topic)
                 ob.sid = self.ctx.register_subscriber(ob, clientid)

@@ -29,6 +29,8 @@ class GatewayCtx:
         if self.node.banned.check(clientinfo):
             return False
         self.node.metrics.inc("client.authenticate")
+        if isinstance(password, str):
+            password = password.encode()   # authn chain expects wire bytes
         res = await self.node.hooks.run_fold_async(
             "client.authenticate", (clientinfo,),
             {"ok": True, "password": password})

@@ -171,8 +171,26 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             lambda: self, local_addr=(self.bind, self.port))
         if self.port == 0:
             self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = loop.create_task(self._sweep_loop())
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            self.sweep()
+
+    def sweep(self) -> None:
+        """Keepalive expiry: a silent connected client loses its session
+        and its will fires — the abnormal-loss case wills exist for."""
+        now = time.monotonic()
+        for c in list(self.by_clientid.values()):
+            if (c.state == "connected" and c.keepalive
+                    and now - c.last_seen > c.keepalive * 1.5):
+                self._publish_will(c)
+                self._drop(c)
 
     async def stop(self) -> None:
+        if getattr(self, "_sweeper", None):
+            self._sweeper.cancel()
         for c in list(self.clients.values()):
             self._drop(c)
         if self.transport:
@@ -247,8 +265,10 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         if not await self.ctx.authenticate(client.clientinfo):
             self.send(addr, CONNACK, bytes([RC_NOT_SUPPORTED]))
             return
-        old = self.by_clientid.get(clientid)
-        if old is not None and old.addr != addr:
+        old = self.by_clientid.get(clientid) or self.clients.get(addr)
+        if old is not None:
+            # duplicate/retransmitted CONNECT (same or new address): the old
+            # registration must go or its sid double-delivers
             self._drop(old)
         self.clients[addr] = client
         self.by_clientid[clientid] = client
@@ -399,7 +419,8 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             client.state = "asleep"
             self.send(addr, DISCONNECT)
             return
-        self._publish_will(client)
+        # clean disconnect: the will is NOT published (wills fire only on
+        # abnormal loss — keepalive expiry in sweep())
         self._drop(client)
         self.send(addr, DISCONNECT)
 

@@ -84,13 +84,16 @@ class FrameParser:
             self.buf = self.buf[1:]
         if not self.buf:
             return None
-        head_end = self.buf.find(b"\n\n")
-        sep = 2
-        if head_end < 0:
-            head_end = self.buf.find(b"\r\n\r\n")
-            sep = 4
-            if head_end < 0:
-                return None
+        # take whichever header terminator appears FIRST: a CRLF frame whose
+        # body contains "\n\n" must not be cut at the body (STOMP 1.2 EOLs)
+        idx_lf = self.buf.find(b"\n\n")
+        idx_crlf = self.buf.find(b"\r\n\r\n")
+        if idx_crlf >= 0 and (idx_lf < 0 or idx_crlf <= idx_lf - 1):
+            head_end, sep = idx_crlf, 4
+        elif idx_lf >= 0:
+            head_end, sep = idx_lf, 2
+        else:
+            return None
         head = self.buf[:head_end].decode("utf-8", "replace")
         lines = head.replace("\r\n", "\n").split("\n")
         command = lines[0].strip()

@@ -239,8 +239,6 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         if r is None:
             raise ApiError(404, "RULE_NOT_FOUND")
         body = req.json() or {}
-        if "enabled" in body:
-            eng.enable_rule(r.id, bool(body["enabled"]))
         if "sql" in body or "actions" in body or "description" in body:
             # validate EVERYTHING before touching the existing rule so a
             # bad update can never destroy a working rule
@@ -257,11 +255,12 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
                                "actions must be a list of {name, params}")
             enabled = r.enabled
             eng.delete_rule(r.id)
-            r = eng.create_rule(body.get("sql", r.sql),
-                                body.get("actions", r.actions),
+            r = eng.create_rule(body.get("sql", r.sql), actions,
                                 rule_id=req.params["id"], enabled=enabled,
                                 description=body.get("description",
                                                      r.description))
+        if "enabled" in body:   # applied last: validation already passed
+            eng.enable_rule(r.id, bool(body["enabled"]))
         return r.to_map()
     route("PUT", "/rules/:id", rule_update)
 
@@ -286,7 +285,7 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
                                                         "mqtt:tcp"),
                  "bind": f"{getattr(l, 'bind', '0.0.0.0')}:"
                          f"{getattr(l, 'port', 0)}",
-                 "current_conns": getattr(l, "conn_count", 0)}
+                 "current_conns": getattr(l, "current_conns", 0)}
                 for l in node.listeners]
     route("GET", "/listeners", listeners)
 

@@ -391,11 +391,25 @@ class TestMqttSn:
             c.send(SN.WILLMSG, b"gone")
             t, body = await c.recv()
             assert t == SN.CONNACK and body[0] == 0
-            # plain DISCONNECT publishes the will in MQTT-SN (no clean flag)
+            # clean DISCONNECT must NOT publish the will
             c.send(SN.DISCONNECT)
             await c.recv()
             await asyncio.sleep(0.05)
-            assert cap.msgs[0].payload == b"gone"
+            assert cap.msgs == []
+            # reconnect with a will; abnormal loss (keepalive expiry) fires
+            c.send(SN.CONNECT, bytes([SN.FLAG_WILL, 1]) +
+                   struct.pack(">H", 1) + b"willdev")
+            await c.recv()                       # WILLTOPICREQ
+            c.send(SN.WILLTOPIC, bytes([0]) + b"will/t")
+            await c.recv()                       # WILLMSGREQ
+            c.send(SN.WILLMSG, b"died")
+            await c.recv()                       # CONNACK
+            client = gw.by_clientid["willdev"]
+            client.last_seen -= 10               # silent past 1.5*keepalive
+            gw.sweep()
+            await asyncio.sleep(0.05)
+            assert [m.payload for m in cap.msgs] == [b"died"]
+            assert "willdev" not in gw.by_clientid
         run(loop, go())
 
 

@@ -1,0 +1,233 @@
+"""Resource / connector / MQTT-bridge tests.
+
+Mirrors the reference's emqx_resource_SUITE + emqx_bridge_mqtt_tests:
+replayq durability, resource lifecycle + health transitions, bridge
+forward/ingress against a real second broker, and outage replay."""
+
+import asyncio
+import json
+import socket
+
+import pytest
+
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.resources import MqttBridgeWorker, ResourceManager
+from emqx_tpu.utils.replayq import ReplayQ
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 30))
+
+
+class Capture:
+    def __init__(self):
+        self.msgs = []
+
+    def deliver(self, f, m):
+        self.msgs.append(m)
+        return True
+
+
+class TestReplayQ:
+    def test_mem_mode(self):
+        q = ReplayQ()
+        q.append(b"a")
+        q.append(b"b")
+        items, ref = q.pop(5)
+        assert items == [b"a", b"b"]
+        q.ack(ref)
+        assert q.is_empty()
+
+    def test_disk_append_pop_ack(self, tmp_path):
+        q = ReplayQ(str(tmp_path / "q"))
+        for i in range(10):
+            q.append(b"item-%d" % i)
+        items, ref = q.pop(4)
+        assert items == [b"item-0", b"item-1", b"item-2", b"item-3"]
+        q.ack(ref)
+        items, _ = q.pop(3)
+        assert items == [b"item-4", b"item-5", b"item-6"]
+
+    def test_unacked_items_survive_restart(self, tmp_path):
+        d = str(tmp_path / "q")
+        q = ReplayQ(d)
+        for i in range(5):
+            q.append(b"m%d" % i)
+        items, ref = q.pop(2)
+        q.ack(ref)
+        items, _ref = q.pop(2)     # popped but NOT acked
+        assert items == [b"m2", b"m3"]
+        q2 = ReplayQ(d)            # simulated crash + restart
+        items, ref = q2.pop(10)
+        assert items == [b"m2", b"m3", b"m4"]   # unacked replayed
+        q2.ack(ref)
+        assert ReplayQ(d).is_empty()
+
+    def test_segment_rotation(self, tmp_path):
+        q = ReplayQ(str(tmp_path / "q"), seg_bytes=64)
+        for i in range(20):
+            q.append(b"x" * 16)
+        assert q.count() == 20
+        items, ref = q.pop(20)
+        assert len(items) == 20
+        q.ack(ref)
+        assert q.is_empty()
+
+
+class TestResourceManager:
+    def test_mqtt_resource_lifecycle(self, loop):
+        async def go():
+            remote = Node(use_device=False)
+            lst = Listener(remote, bind="127.0.0.1", port=0)
+            await lst.start()
+            node = Node(use_device=False)
+            rm = ResourceManager(node, health_interval=0.1)
+            res = await rm.create("r1", "mqtt", {"port": lst.port})
+            assert res.status == "connected"
+            assert await res.health_check()
+            cap = Capture()
+            remote.broker.subscribe(remote.broker.register(cap, "c"),
+                                    "res/#")
+            await res.query({"topic": "res/t", "payload": b"ping"})
+            await asyncio.sleep(0.1)
+            assert cap.msgs[0].payload == b"ping"
+            assert rm.list()[0]["status"] == "connected"
+            await rm.remove("r1")
+            assert rm.list() == []
+            await lst.stop()
+        run(loop, go())
+
+    def test_unknown_type_rejected(self, loop):
+        node = Node(use_device=False)
+        rm = ResourceManager(node)
+        with pytest.raises(ValueError):
+            run(loop, rm.create("x", "nope", {}))
+
+    def test_rule_action_via_resource(self, loop):
+        async def go():
+            remote = Node(use_device=False)
+            lst = Listener(remote, bind="127.0.0.1", port=0)
+            await lst.start()
+            cap = Capture()
+            remote.broker.subscribe(remote.broker.register(cap, "c"),
+                                    "sink/#")
+            node = Node(use_device=False)
+            rm = ResourceManager(node)
+            await rm.create("sink", "mqtt", {"port": lst.port})
+            from emqx_tpu.rules import RuleEngine
+            eng = RuleEngine(node).load()
+            eng.create_rule(
+                'SELECT payload.v as v, topic FROM "src/#"',
+                [{"name": "data_to_sink",
+                  "params": {"target_topic": "sink/${topic}",
+                             "payload_tmpl": '{"fwd":${v}}'}}])
+            node.broker.publish(make("p", 0, "src/a",
+                                     json.dumps({"v": 9}).encode()))
+            for _ in range(50):
+                await asyncio.sleep(0.05)
+                if cap.msgs:
+                    break
+            assert cap.msgs[0].topic == "sink/src/a"
+            assert json.loads(cap.msgs[0].payload) == {"fwd": 9}
+            await rm.remove("sink")
+            await lst.stop()
+        run(loop, go())
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestMqttBridge:
+    def test_forward_and_ingress(self, loop, tmp_path):
+        async def go():
+            remote = Node(use_device=False)
+            rlst = Listener(remote, bind="127.0.0.1", port=0)
+            await rlst.start()
+            local = Node(use_device=False)
+            bridge = MqttBridgeWorker(local, "b1", {
+                "host": "127.0.0.1", "port": rlst.port,
+                "forwards": ["out/#"],
+                "subscriptions": [{"topic": "cmd/#", "qos": 1}],
+                "forward_mountpoint": "from-local/",
+                "receive_mountpoint": "from-remote/",
+                "queue_dir": str(tmp_path / "bq"),
+                "reconnect_interval": 0.2})
+            await bridge.start()
+            for _ in range(50):
+                await asyncio.sleep(0.05)
+                if bridge.state == "connected":
+                    break
+            assert bridge.state == "connected"
+            # forward: local publish -> remote with mountpoint
+            rcap = Capture()
+            remote.broker.subscribe(
+                remote.broker.register(rcap, "rc"), "from-local/#")
+            local.broker.publish(make("c", 1, "out/temp", b"fwd"))
+            for _ in range(50):
+                await asyncio.sleep(0.05)
+                if rcap.msgs:
+                    break
+            assert rcap.msgs[0].topic == "from-local/out/temp"
+            assert rcap.msgs[0].payload == b"fwd"
+            # ingress: remote publish -> local with mountpoint
+            lcap = Capture()
+            local.broker.subscribe(
+                local.broker.register(lcap, "lc"), "from-remote/#")
+            remote.broker.publish(make("r", 0, "cmd/go", b"ing"))
+            for _ in range(50):
+                await asyncio.sleep(0.05)
+                if lcap.msgs:
+                    break
+            assert lcap.msgs[0].topic == "from-remote/cmd/go"
+            await bridge.stop()
+            await rlst.stop()
+        run(loop, go())
+
+    def test_outage_buffers_and_replays(self, loop, tmp_path):
+        async def go():
+            port = _free_port()
+            local = Node(use_device=False)
+            bridge = MqttBridgeWorker(local, "b2", {
+                "host": "127.0.0.1", "port": port,
+                "forwards": ["q/#"],
+                "queue_dir": str(tmp_path / "bq2"),
+                "reconnect_interval": 0.2})
+            await bridge.start()     # remote not up yet: state connecting
+            # publishes while remote is DOWN are queued on disk
+            for i in range(5):
+                local.broker.publish(make("c", 1, "q/m", b"%d" % i))
+            await asyncio.sleep(0.3)
+            assert bridge.queue.count() == 5
+            assert bridge.state != "connected"
+            # remote comes up on the expected port
+            remote = Node(use_device=False)
+            rlst = Listener(remote, bind="127.0.0.1", port=port)
+            await rlst.start()
+            rcap = Capture()
+            remote.broker.subscribe(
+                remote.broker.register(rcap, "rc"), "q/#")
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if len(rcap.msgs) == 5:
+                    break
+            assert [m.payload for m in rcap.msgs] == \
+                [b"0", b"1", b"2", b"3", b"4"]   # ordered replay
+            assert bridge.queue.is_empty()
+            await bridge.stop()
+            await rlst.stop()
+        run(loop, go())
