@@ -148,8 +148,10 @@ class ExhookServer:
         method, req_cls, valued = HOOK_METHODS[hookpoint]
         server = self
 
-        if hookpoint in ("client.authenticate", "client.authorize"):
-            # these run under run_fold_async (the channel awaits them)
+        if valued:
+            # valued hooks run under awaited folds: authenticate/authorize
+            # via the channel's run_fold_async, message.publish via
+            # Broker.publish_async (sync Broker.publish skips them)
             async def ahandler(*args):
                 req = server._build_request(hookpoint, req_cls, args)
                 if req is None:
@@ -165,26 +167,6 @@ class ExhookServer:
                     return None
                 return server._apply_valued(hookpoint, resp, args)
             return ahandler
-
-        if valued:   # message.publish runs under the SYNC run_fold: the
-            # reference blocks the channel process on this gRPC call
-            # (emqx_exhook_server request timeout); here the call blocks
-            # in-thread, bounded by the configured timeout
-            def vhandler(*args):
-                req = server._build_request(hookpoint, req_cls, args)
-                if req is None:
-                    return None
-                try:
-                    resp = server._call_blocking(method, req,
-                                                 pb.ValuedResponse)
-                except grpc.RpcError as e:
-                    log.warning("exhook %s %s failed: %s", server.name,
-                                method, e)
-                    if server.failed_action == "deny":
-                        return server._deny_value(hookpoint, args)
-                    return None
-                return server._apply_valued(hookpoint, resp, args)
-            return vhandler
 
         # non-valued hooks never block the hot path: fire-and-forget
         async def notify(args):

@@ -1,0 +1,87 @@
+"""StatsD exporter.
+
+Parity: apps/emqx_statsd — periodic UDP push of broker metrics (counters
+as deltas `|c`) and stats (gauges `|g`) to a StatsD daemon.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Optional
+
+log = logging.getLogger("emqx_tpu.statsd")
+
+
+class StatsdApp:
+    def __init__(self, node, conf: Optional[dict] = None):
+        self.node = node
+        c = dict(node.config.get("statsd") or {})
+        c.update(conf or {})
+        self.host = c.get("host", "127.0.0.1")
+        self.port = c.get("port", 8125)
+        self.prefix = c.get("prefix", "emqx")
+        self.interval = c.get("interval", 10.0)
+        self.batch_bytes = c.get("batch_bytes", 1400)
+        self._last: dict[str, int] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._sock: Optional[socket.socket] = None
+
+    def load(self) -> "StatsdApp":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self.node.statsd = self
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    def unload(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sock:
+            self._sock.close()
+            self._sock = None
+        if getattr(self.node, "statsd", None) is self:
+            self.node.statsd = None
+
+    def render(self) -> list[str]:
+        """Metric lines for one flush: counter deltas + stat gauges."""
+        lines = []
+        for name, val in sorted(self.node.metrics.all().items()):
+            delta = val - self._last.get(name, 0)
+            self._last[name] = val
+            if delta:
+                lines.append(f"{self.prefix}.{name}:{delta}|c")
+        for name, val in sorted(self.node.stats.sample().items()):
+            lines.append(f"{self.prefix}.{name}:{val}|g")
+        return lines
+
+    def flush(self) -> int:
+        """Send one batch now; returns datagrams sent."""
+        if self._sock is None:
+            return 0
+        sent = 0
+        batch: list[str] = []
+        size = 0
+        for line in self.render():
+            if size + len(line) + 1 > self.batch_bytes and batch:
+                self._send("\n".join(batch))
+                sent += 1
+                batch, size = [], 0
+            batch.append(line)
+            size += len(line) + 1
+        if batch:
+            self._send("\n".join(batch))
+            sent += 1
+        return sent
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), (self.host, self.port))
+        except OSError as e:
+            log.debug("statsd send failed: %s", e)
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            self.flush()

@@ -115,11 +115,18 @@ class Hooks:
         return False, res
 
     def run_fold(self, name: str, args: tuple, acc: Any) -> Any:
-        """Parity: emqx_hooks:run_fold/3 — threads acc; ('stop',acc) halts."""
+        """Parity: emqx_hooks:run_fold/3 — threads acc; ('stop',acc) halts.
+
+        Async callbacks (exhook) are skipped here — they only take effect
+        on the awaited paths (run_fold_async / Broker.publish_async)."""
         for cb in self._chains.get(name, ()):
             if cb.filter and not cb.filter(*args, acc):
                 continue
-            stop, acc = self._fold_step(cb.action(*args, acc), acc)
+            res = cb.action(*args, acc)
+            if inspect.isawaitable(res):
+                res.close()
+                continue
+            stop, acc = self._fold_step(res, acc)
             if stop:
                 return acc
         return acc

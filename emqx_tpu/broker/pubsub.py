@@ -170,8 +170,22 @@ class Broker:
 
         A hook setting allow_publish=false (delayed interception, rule-engine
         republish guards) stops routing quietly — the reference just returns
-        [] without counting a drop (emqx_broker.erl:203-208)."""
+        [] without counting a drop (emqx_broker.erl:203-208).
+
+        Async message.publish callbacks (exhook gRPC) are skipped on this
+        sync path; client publishes go through publish_async which awaits
+        them (the reference blocks the channel process there)."""
         msg = self.hooks.run_fold("message.publish", (), msg)
+        if msg is None or msg.get_header("allow_publish") is False:
+            return 0
+        self.metrics.inc("messages.publish")
+        return self._route(msg, self.router.match(msg.topic))
+
+    async def publish_async(self, msg: Message) -> int:
+        """publish/1 with awaited message.publish callbacks — the channel's
+        per-client PUBLISH path, where a slow extension blocks only this
+        client like the reference's channel process."""
+        msg = await self.hooks.run_fold_async("message.publish", (), msg)
         if msg is None or msg.get_header("allow_publish") is False:
             return 0
         self.metrics.inc("messages.publish")

@@ -106,7 +106,13 @@ class MqttBridgeWorker:
                 await self.client.subscribe(topic, qos=qos)
             self.state = "connected"
             self._wakeup.set()
-            self._tasks.append(asyncio.create_task(self._ingress_loop()))
+            t = asyncio.create_task(self._ingress_loop())
+            self._tasks.append(t)
+            # prune finished ingress tasks so a flapping remote can't grow
+            # the list without bound
+            t.add_done_callback(
+                lambda t: self._tasks.remove(t)
+                if t in self._tasks else None)
             log.info("bridge %s connected to %s:%s", self.name,
                      self.conf.get("host"), self.conf.get("port"))
         except Exception as e:  # noqa: BLE001

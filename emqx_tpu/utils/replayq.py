@@ -21,15 +21,19 @@ DEFAULT_SEG_BYTES = 10 << 20
 
 class ReplayQ:
     def __init__(self, dir: Optional[str] = None,
-                 seg_bytes: int = DEFAULT_SEG_BYTES):
+                 seg_bytes: int = DEFAULT_SEG_BYTES,
+                 fsync: bool = True):
         self.dir = dir
         self.seg_bytes = seg_bytes
+        self.fsync = fsync
         self._mem: list[bytes] = []
         # reader position: (segno, item offset within segment)
         self._rseg = 0
         self._roff = 0
         self._wseg = 0
-        self._wfile = None
+        self._count = 0                     # live (unacked) items
+        self._cache_seg: Optional[int] = None   # parsed-segment read cache
+        self._cache_items: list[bytes] = []
         if dir is not None:
             os.makedirs(dir, exist_ok=True)
             self._recover()
@@ -56,6 +60,16 @@ class ReplayQ:
         for s in segs:
             if s < self._rseg:
                 os.unlink(self._seg_path(s))
+        self._count = self._scan_count()
+
+    def _scan_count(self) -> int:
+        total = 0
+        seg, off = self._rseg, self._roff
+        while seg <= self._wseg:
+            total += max(0, len(self._read_seg(seg)) - off)
+            seg += 1
+            off = 0
+        return total
 
     def _read_seg(self, segno: int) -> list[bytes]:
         try:
@@ -74,9 +88,14 @@ class ReplayQ:
 
     # ---- queue api ----
     def append(self, item: bytes) -> None:
+        self._count += 1
         if self.dir is None:
             self._mem.append(item)
             return
+        if self._wseg < self._rseg:
+            # a full drain advanced the reader past the old write segment;
+            # never write behind the read pointer or items become invisible
+            self._wseg = self._rseg
         path = self._seg_path(self._wseg)
         if (os.path.exists(path)
                 and os.path.getsize(path) >= self.seg_bytes):
@@ -85,7 +104,9 @@ class ReplayQ:
         with open(path, "ab") as f:
             f.write(struct.pack(">I", len(item)) + item)
             f.flush()
-            os.fsync(f.fileno())
+            if self.fsync:
+                os.fsync(f.fileno())
+        self._cache_seg = None   # invalidate read cache
 
     def pop(self, n: int = 1) -> tuple[list[bytes], Optional[tuple]]:
         """Return up to n items and an ack ref (None when empty)."""
@@ -95,7 +116,7 @@ class ReplayQ:
         items: list[bytes] = []
         seg, off = self._rseg, self._roff
         while len(items) < n and seg <= self._wseg:
-            seg_items = self._read_seg(seg)
+            seg_items = self._seg_items_cached(seg)
             take = seg_items[off:off + (n - len(items))]
             items.extend(take)
             off += len(take)
@@ -106,9 +127,17 @@ class ReplayQ:
             return [], None
         return items, (seg, off)
 
+    def _seg_items_cached(self, seg: int) -> list[bytes]:
+        if self._cache_seg != seg:
+            self._cache_items = self._read_seg(seg)
+            self._cache_seg = seg
+        return self._cache_items
+
     def ack(self, ref: tuple) -> None:
         if self.dir is None:
-            self._mem = self._mem[ref[1]:]
+            acked = ref[1]
+            self._mem = self._mem[acked:]
+            self._count = len(self._mem)
             return
         seg, off = ref
         with open(self._commit_path(), "w") as f:
@@ -121,17 +150,10 @@ class ReplayQ:
             except FileNotFoundError:
                 pass
         self._rseg, self._roff = seg, off
+        self._count = self._scan_count()
 
     def count(self) -> int:
-        if self.dir is None:
-            return len(self._mem)
-        total = 0
-        seg, off = self._rseg, self._roff
-        while seg <= self._wseg:
-            total += max(0, len(self._read_seg(seg)) - off)
-            seg += 1
-            off = 0
-        return total
+        return self._count if self.dir is not None else len(self._mem)
 
     def is_empty(self) -> bool:
         return self.count() == 0

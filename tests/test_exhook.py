@@ -146,8 +146,9 @@ def test_message_publish_mutation_and_topic_filter(loop):
         await ex.add_server("p1", f"127.0.0.1:{port}")
         cap = Cap()
         node.broker.subscribe(node.broker.register(cap, "c"), "#")
-        node.broker.publish(make("pub", 0, "only/x", b"data"))
-        node.broker.publish(make("pub", 0, "other/x", b"data"))
+        # client publishes go through the awaited path (publish_async)
+        await node.broker.publish_async(make("pub", 0, "only/x", b"data"))
+        await node.broker.publish_async(make("pub", 0, "other/x", b"data"))
         assert cap.msgs[0].payload == b"data-mutated"   # filtered topic hit
         assert cap.msgs[1].payload == b"data"           # filter miss: as-is
         assert prov.names().count("publish") == 1

@@ -231,3 +231,19 @@ class TestMqttBridge:
             await bridge.stop()
             await rlst.stop()
         run(loop, go())
+
+    def test_append_after_full_drain_stays_visible(self, tmp_path):
+        """Regression: ack after a full drain must not orphan future
+        appends (the read pointer once advanced past the write segment)."""
+        q = ReplayQ(str(tmp_path / "qd"))
+        q.append(b"a")
+        items, ref = q.pop(10)
+        assert items == [b"a"]
+        q.ack(ref)
+        assert q.is_empty()
+        q.append(b"b")                  # appended AFTER the drain
+        assert q.count() == 1
+        items, ref = q.pop(10)
+        assert items == [b"b"]
+        q.ack(ref)
+        assert ReplayQ(str(tmp_path / "qd")).is_empty()

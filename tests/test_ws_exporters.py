@@ -1,0 +1,259 @@
+"""WebSocket listener + Prometheus/StatsD exporter tests.
+
+Mirrors the reference's emqx_ws_connection tests (MQTT over websocket with
+the mqtt subprotocol) and emqx_prometheus/emqx_statsd suites."""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from emqx_tpu.apps.prometheus import PrometheusApp, collect, register_api
+from emqx_tpu.apps.statsd import StatsdApp
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+from emqx_tpu.broker.ws import (OP_BIN, OP_CLOSE, OP_PING, OP_PONG,
+                                WsListener, accept_key, encode_frame)
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import FrameParser, serialize
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 20))
+
+
+class WsClient:
+    """Minimal RFC6455 client speaking MQTT over binary frames."""
+
+    def __init__(self, port, path="/mqtt"):
+        self.port = port
+        self.path = path
+        self.parser = FrameParser()
+        self.packets = asyncio.Queue()
+        self.control = asyncio.Queue()
+
+    async def connect_ws(self, subprotocol="mqtt"):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        key = "dGhlIHNhbXBsZSBub25jZQ=="
+        req = (f"GET {self.path} HTTP/1.1\r\nhost: x\r\n"
+               "upgrade: websocket\r\nconnection: Upgrade\r\n"
+               f"sec-websocket-key: {key}\r\n"
+               "sec-websocket-version: 13\r\n")
+        if subprotocol:
+            req += f"sec-websocket-protocol: {subprotocol}\r\n"
+        self.writer.write((req + "\r\n").encode())
+        await self.writer.drain()
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        status = head.split(b"\r\n")[0]
+        if b"101" not in status:
+            return head.decode()
+        assert accept_key(key).encode() in head
+        self.headers = head.decode().lower()
+        self._rx = asyncio.ensure_future(self._rx_loop())
+        return None
+
+    def send_ws(self, opcode, payload):
+        # client frames must be masked
+        mask = b"\x11\x22\x33\x44"
+        masked = bytes(c ^ mask[i & 3] for i, c in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            head = bytes([0x80 | opcode, 0x80 | n])
+        else:
+            head = bytes([0x80 | opcode, 0x80 | 126]) + struct.pack(">H", n)
+        self.writer.write(head + mask + masked)
+
+    def send_mqtt(self, pkt, ver=4):
+        self.send_ws(OP_BIN, serialize(pkt, ver))
+
+    async def _rx_loop(self):
+        from emqx_tpu.broker.ws import read_frame
+        while True:
+            frame = await read_frame(self.reader)
+            if frame is None:
+                return
+            opcode, _fin, payload = frame
+            if opcode == OP_BIN:
+                for pkt in self.parser.feed(payload):
+                    self.packets.put_nowait(pkt)
+            else:
+                self.control.put_nowait((opcode, payload))
+
+    async def recv(self, timeout=5):
+        return await asyncio.wait_for(self.packets.get(), timeout)
+
+    def close(self):
+        self._rx.cancel()
+        self.writer.close()
+
+
+@pytest.fixture()
+def ws(loop):
+    node = Node(use_device=False)
+    lst = WsListener(node, bind="127.0.0.1", port=0)
+    loop.run_until_complete(lst.start())
+    yield node, lst
+    loop.run_until_complete(lst.stop())
+
+
+class TestWsListener:
+    def test_handshake_and_subprotocol(self, loop, ws):
+        node, lst = ws
+
+        async def go():
+            c = WsClient(lst.port)
+            err = await c.connect_ws()
+            assert err is None
+            assert "sec-websocket-protocol: mqtt" in c.headers
+            c.close()
+        run(loop, go())
+
+    def test_bad_path_rejected(self, loop, ws):
+        node, lst = ws
+
+        async def go():
+            c = WsClient(lst.port, path="/other")
+            err = await c.connect_ws()
+            assert err is not None and "400" in err
+        run(loop, go())
+
+    def test_mqtt_over_ws_roundtrip(self, loop, ws):
+        node, lst = ws
+
+        async def go():
+            c = WsClient(lst.port)
+            await c.connect_ws()
+            c.send_mqtt(P.Connect(clientid="ws-1", keepalive=60))
+            ack = await c.recv()
+            assert isinstance(ack, P.Connack) and ack.reason_code == 0
+            c.send_mqtt(P.Subscribe(packet_id=1,
+                                    filters=[("ws/t",
+                                              P.SubOpts(qos=1))]))
+            suback = await c.recv()
+            assert isinstance(suback, P.Suback)
+            # core -> ws
+            node.broker.publish(make("x", 0, "ws/t", b"over-ws"))
+            pub = await c.recv()
+            assert isinstance(pub, P.Publish) and pub.payload == b"over-ws"
+            # ws -> core
+            class Cap:
+                def __init__(self):
+                    self.msgs = []
+
+                def deliver(self, f, m):
+                    self.msgs.append(m)
+                    return True
+            cap = Cap()
+            node.broker.subscribe(node.broker.register(cap, "c"), "up/#")
+            c.send_mqtt(P.Publish(topic="up/x", payload=b"from-ws"))
+            await asyncio.sleep(0.1)
+            assert cap.msgs[0].payload == b"from-ws"
+            assert node.cm.lookup_channel("ws-1") is not None
+            c.close()
+        run(loop, go())
+
+    def test_ping_pong_and_fragmentation(self, loop, ws):
+        node, lst = ws
+
+        async def go():
+            c = WsClient(lst.port)
+            await c.connect_ws()
+            c.send_ws(OP_PING, b"hb")
+            op, payload = await asyncio.wait_for(c.control.get(), 5)
+            assert op == OP_PONG and payload == b"hb"
+            # CONNECT split across two fragments
+            data = serialize(P.Connect(clientid="frag-1", keepalive=60), 4)
+            mid = len(data) // 2
+            mask = b"\x00\x00\x00\x00"
+            self_buf = data[:mid]
+            c.writer.write(bytes([OP_BIN, 0x80 | len(self_buf)]) + mask +
+                           self_buf)   # FIN=0
+            await asyncio.sleep(0.05)
+            rest = data[mid:]
+            c.writer.write(bytes([0x80 | 0x0, 0x80 | len(rest)]) + mask +
+                           rest)       # CONT FIN=1
+            ack = await c.recv()
+            assert isinstance(ack, P.Connack)
+            c.close()
+        run(loop, go())
+
+
+class TestPrometheus:
+    def test_collect_text_format(self):
+        node = Node(use_device=False)
+        node.metrics.inc("messages.publish", 7)
+        node.stats.setstat("connections.count", 3, "connections.max")
+        text = collect(node)
+        assert "# TYPE emqx_messages_publish counter" in text
+        assert "emqx_messages_publish 7" in text
+        assert "emqx_connections_max 3" in text
+        assert "emqx_vm_used_memory_kb" in text
+
+    def test_rule_metrics_labels(self):
+        from emqx_tpu.rules import RuleEngine
+        node = Node(use_device=False)
+        eng = RuleEngine(node).load()
+        eng.create_rule('SELECT * FROM "m/#"',
+                        [{"name": "do_nothing", "params": {}}],
+                        rule_id="rule-x")
+        node.broker.publish(make("p", 0, "m/1", b""))
+        text = collect(node)
+        assert 'emqx_rule_sql_matched{rule="rule_x"} 1' in text
+
+    def test_scrape_endpoint(self, loop):
+        from emqx_tpu.mgmt.httpd import HttpServer
+        node = Node(use_device=False)
+        srv = HttpServer("127.0.0.1", 0)
+        register_api(srv, node)
+
+        async def go():
+            await srv.start()
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           srv.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nhost: x\r\n"
+                         b"connection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read(-1)
+            assert b"200" in raw.split(b"\r\n")[0]
+            assert b"# TYPE emqx_" in raw
+            writer.close()
+            await srv.stop()
+        run(loop, go())
+
+
+class TestStatsd:
+    def test_counter_deltas_and_gauges(self, loop):
+        node = Node(use_device=False)
+
+        async def go():
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind(("127.0.0.1", 0))
+            sock.setblocking(False)
+            port = sock.getsockname()[1]
+            app = StatsdApp(node, {"host": "127.0.0.1", "port": port,
+                                   "interval": 60})
+            app.load()
+            node.metrics.inc("messages.publish", 5)
+            app.flush()
+            await asyncio.sleep(0.1)
+            data = sock.recv(65536).decode()
+            assert "emqx.messages.publish:5|c" in data
+            assert "|g" in data               # stats gauges present
+            # second flush: only the delta
+            node.metrics.inc("messages.publish", 2)
+            app.flush()
+            await asyncio.sleep(0.1)
+            data = sock.recv(65536).decode()
+            assert "emqx.messages.publish:2|c" in data
+            app.unload()
+            sock.close()
+        run(loop, go())
