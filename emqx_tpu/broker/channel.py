@@ -713,7 +713,8 @@ class Channel:
         if self.conn_state in (CONN_CONNECTED, CONN_DISCONNECTED):
             self.node.cm.unregister_channel(self.clientid, self)
         if self.will_msg is not None and reason not in ("takenover",):
-            self.node.broker.publish(self.will_msg)
+            # scheduled so exhook's async message.publish hooks still apply
+            self.node.broker.publish_soon(self.will_msg)
             self.will_msg = None
         if sess is not None and self.conn_state == CONN_CONNECTED:
             if park:

@@ -191,6 +191,18 @@ class Broker:
         self.metrics.inc("messages.publish")
         return self._route(msg, self.router.match(msg.topic))
 
+    def publish_soon(self, msg: Message) -> None:
+        """Fire-and-forget publish from sync code paths (will messages,
+        gateway datagrams, rule republish): schedules publish_async so
+        async extension hooks (exhook) still see the message; falls back
+        to the sync path when no loop is running."""
+        import asyncio
+        try:
+            asyncio.get_running_loop().create_task(
+                self.publish_async(msg))
+        except RuntimeError:
+            self.publish(msg)
+
     def publish_batch(self, msgs: list[Message]) -> list[int]:
         """Micro-batched publish: one device match for the whole batch
         (the {active,N}-window analog, SURVEY.md P10)."""

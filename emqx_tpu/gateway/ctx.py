@@ -84,10 +84,14 @@ class GatewayCtx:
                 headers: Optional[dict] = None) -> int:
         msg = make(self._cid(clientid), qos, topic, payload,
                    flags={"retain": retain}, headers=headers or {})
-        return self.node.broker.publish(msg)
+        # scheduled (not inline): async extension hooks must see gateway
+        # publishes too; gateway callers don't consume the delivery count
+        self.node.broker.publish_soon(msg)
+        return 1
 
     def publish_msg(self, msg: Message) -> int:
-        return self.node.broker.publish(msg)
+        self.node.broker.publish_soon(msg)
+        return 1
 
     def metrics_inc(self, name: str, n: int = 1) -> None:
         self.node.metrics.inc(f"gateway.{self.gwname}.{name}", n)

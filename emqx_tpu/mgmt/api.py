@@ -120,11 +120,11 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         body = req.json() or {}
         if "topic" not in body:
             raise ApiError(400, "BAD_REQUEST", "topic required")
-        n = mgmt.publish(body["topic"], _decode_payload(body),
-                         qos=int(body.get("qos", 0)),
-                         retain=bool(body.get("retain", False)),
-                         clientid=body.get("clientid", "http_api"),
-                         properties=body.get("properties"))
+        n = await mgmt.publish(body["topic"], _decode_payload(body),
+                               qos=int(body.get("qos", 0)),
+                               retain=bool(body.get("retain", False)),
+                               clientid=body.get("clientid", "http_api"),
+                               properties=body.get("properties"))
         return {"deliveries": n}
     route("POST", "/publish", publish)
     route("POST", "/mqtt/publish", publish)
@@ -132,11 +132,12 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
     async def publish_batch(req):
         out = []
         for body in req.json() or []:
-            n = mgmt.publish(body["topic"], _decode_payload(body),
-                             qos=int(body.get("qos", 0)),
-                             retain=bool(body.get("retain", False)),
-                             clientid=body.get("clientid", "http_api"),
-                             properties=body.get("properties"))
+            n = await mgmt.publish(
+                body["topic"], _decode_payload(body),
+                qos=int(body.get("qos", 0)),
+                retain=bool(body.get("retain", False)),
+                clientid=body.get("clientid", "http_api"),
+                properties=body.get("properties"))
             out.append({"topic": body["topic"], "deliveries": n})
         return out
     route("POST", "/mqtt/publish_batch", publish_batch)

@@ -189,9 +189,9 @@ class Mgmt:
                 return r
         return None
 
-    def publish(self, topic: str, payload: bytes, qos: int = 0,
-                retain: bool = False, clientid: str = "http_api",
-                properties: Optional[dict] = None) -> int:
+    async def publish(self, topic: str, payload: bytes, qos: int = 0,
+                      retain: bool = False, clientid: str = "http_api",
+                      properties: Optional[dict] = None) -> int:
         from emqx_tpu.utils import topic as T
         try:
             # same topic-NAME validation the MQTT PUBLISH path enforces
@@ -201,7 +201,8 @@ class Mgmt:
         msg = make(clientid, qos, topic, payload,
                    flags={"retain": retain},
                    headers={"properties": properties or {}})
-        return self.node.broker.publish(msg)
+        # awaited path so async extension hooks see API publishes too
+        return await self.node.broker.publish_async(msg)
 
     async def subscribe_client(self, clientid: str, topic: str,
                                qos: int = 0) -> Optional[int]:

@@ -220,10 +220,18 @@ class FrameParser:
         self.strict = strict
         self._buf = bytearray()
 
+    BURST_SCAN_MIN = 4096   # buffer size where the native scan pays off
+
     def feed(self, data: bytes) -> list[Packet]:
         """Append raw bytes; return all complete packets now parseable."""
         self._buf += data
-        out = []
+        out: list[Packet] = []
+        if len(self._buf) >= self.BURST_SCAN_MIN:
+            fast = self._feed_burst()
+            if fast is not None:
+                out.extend(fast)
+        # the incremental loop also drains any frames past the burst
+        # scan's max_frames cap — nothing complete may be left buffered
         while True:
             pkt, consumed = self._try_parse_one()
             if pkt is None:
@@ -231,6 +239,39 @@ class FrameParser:
             del self._buf[:consumed]
             out.append(pkt)
         return out
+
+    def _feed_burst(self) -> Optional[list[Packet]]:
+        """Native boundary scan for read bursts: split the whole buffer in
+        one pass and drop the consumed prefix with one delete (the
+        {active,N} batch path; repeated per-frame prefix deletes are
+        quadratic on large bursts)."""
+        from emqx_tpu import native
+        try:
+            frames, consumed = native.frame_scan(
+                bytes(self._buf), max_frames=4096,
+                max_frame_size=self.max_size or 0)
+        except native.FrameScanError:
+            return None   # let the strict parser raise its precise error
+        if not frames:
+            return []
+        out = []
+        for off, length in frames:
+            pkt = self._parse_frame(bytes(self._buf[off:off + length]))
+            out.append(pkt)
+        del self._buf[:consumed]
+        return out
+
+    def _parse_frame(self, frame: bytes) -> Packet:
+        """Parse one complete frame (header already validated by scan)."""
+        saved = self._buf
+        self._buf = bytearray(frame)
+        try:
+            pkt, consumed = self._try_parse_one()
+            if pkt is None or consumed != len(frame):
+                raise FrameError("malformed_packet", "bad frame boundary")
+            return pkt
+        finally:
+            self._buf = saved
 
     @property
     def pending_bytes(self) -> int:

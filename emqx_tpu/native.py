@@ -1,0 +1,229 @@
+"""ctypes bindings for the native runtime library.
+
+Parity role: SURVEY.md §2.3 — the reference's hot byte paths are native
+(BEAM binary matching, jiffy C JSON); here libemqx_native.so provides the
+frame scanner, topic hashing, wildcard match, and replayq segment scan,
+with pure-Python fallbacks when the library isn't built.
+
+Build with `make -C native` (auto-attempted once on first import when g++
+is present); `available()` reports which implementation is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+log = logging.getLogger("emqx_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libemqx_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError) as e:
+            log.info("native build unavailable: %s", e)
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        log.info("native load failed: %s", e)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.mqtt_frame_scan.restype = ctypes.c_int
+    lib.mqtt_frame_scan.argtypes = [
+        u8p, ctypes.c_size_t, u32p, u32p, ctypes.c_int, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.topic_level_hashes.restype = ctypes.c_int
+    lib.topic_level_hashes.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, u64p, ctypes.c_int]
+    lib.topic_hash_batch.restype = ctypes.c_int
+    lib.topic_hash_batch.argtypes = [
+        ctypes.c_char_p, u32p, u32p, ctypes.c_int, u64p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    lib.topic_match.restype = ctypes.c_int
+    lib.topic_match.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                ctypes.c_char_p, ctypes.c_size_t]
+    lib.replayq_scan.restype = ctypes.c_int
+    lib.replayq_scan.argtypes = [u8p, ctypes.c_size_t, u32p, u32p,
+                                 ctypes.c_int]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------
+# frame scan
+# ---------------------------------------------------------------------
+class FrameScanError(Exception):
+    pass
+
+
+def frame_scan(buf: bytes, max_frames: int = 256,
+               max_frame_size: int = 0) -> tuple[list[tuple[int, int]],
+                                                 int]:
+    """Split a byte buffer into complete MQTT frames.
+
+    Returns ([(offset, length), ...], consumed). Raises FrameScanError on
+    a malformed varint or an oversized frame."""
+    lib = _load()
+    if lib is None:
+        return _frame_scan_py(buf, max_frames, max_frame_size)
+    n = len(buf)
+    arr = (ctypes.c_uint8 * n).from_buffer_copy(buf) if n else \
+        (ctypes.c_uint8 * 1)()
+    off = (ctypes.c_uint32 * max_frames)()
+    lens = (ctypes.c_uint32 * max_frames)()
+    consumed = ctypes.c_size_t(0)
+    rc = lib.mqtt_frame_scan(arr, n, off, lens, max_frames,
+                             max_frame_size, ctypes.byref(consumed))
+    if rc == -1:
+        raise FrameScanError("malformed varint")
+    if rc == -2:
+        raise FrameScanError("frame too large")
+    return ([(off[i], lens[i]) for i in range(rc)], consumed.value)
+
+
+def _frame_scan_py(buf: bytes, max_frames: int,
+                   max_frame_size: int) -> tuple[list[tuple[int, int]],
+                                                 int]:
+    out: list[tuple[int, int]] = []
+    pos = 0
+    consumed = 0
+    while pos + 2 <= len(buf) and len(out) < max_frames:
+        p = pos + 1
+        rem = 0
+        mult = 1
+        nbytes = 0
+        complete = False
+        while p < len(buf) and nbytes < 4:
+            b = buf[p]
+            p += 1
+            rem += (b & 0x7F) * mult
+            mult <<= 7
+            nbytes += 1
+            if not b & 0x80:
+                complete = True
+                break
+        if not complete:
+            if nbytes >= 4:
+                raise FrameScanError("malformed varint")
+            break
+        total = (p - pos) + rem
+        if max_frame_size and total > max_frame_size:
+            raise FrameScanError("frame too large")
+        if pos + total > len(buf):
+            break
+        out.append((pos, total))
+        pos += total
+        consumed = pos
+    return out, consumed
+
+
+# ---------------------------------------------------------------------
+# topic hashing
+# ---------------------------------------------------------------------
+def _fnv1a_py(s: bytes) -> int:
+    h = 1469598103934665603
+    for b in s:
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def topic_hashes(topic: str, max_levels: int = 16) -> list[int]:
+    """Per-level FNV-1a-64 hashes (the intern-table key function)."""
+    lib = _load()
+    raw = topic.encode()
+    if lib is None:
+        return [_fnv1a_py(w) for w in raw.split(b"/")[:max_levels]]
+    out = (ctypes.c_uint64 * max_levels)()
+    n = lib.topic_level_hashes(raw, len(raw), out, max_levels)
+    if n < 0:
+        return [_fnv1a_py(w) for w in raw.split(b"/")[:max_levels]]
+    return list(out[:n])
+
+
+def topic_hashes_batch(topics: list[str],
+                       max_levels: int = 16) -> list[list[int]]:
+    lib = _load()
+    if lib is None or not topics:
+        return [topic_hashes(t, max_levels) for t in topics]
+    raws = [t.encode() for t in topics]
+    buf = b"".join(raws)
+    offs = (ctypes.c_uint32 * len(raws))()
+    lens = (ctypes.c_uint32 * len(raws))()
+    pos = 0
+    for i, r in enumerate(raws):
+        offs[i] = pos
+        lens[i] = len(r)
+        pos += len(r)
+    out = (ctypes.c_uint64 * (len(raws) * max_levels))()
+    counts = (ctypes.c_uint8 * len(raws))()
+    lib.topic_hash_batch(buf, offs, lens, len(raws), out, counts,
+                         max_levels)
+    res = []
+    for i, t in enumerate(topics):
+        if counts[i] == 0xFF:       # deeper than max_levels: fallback
+            res.append(topic_hashes(t, max_levels))
+        else:
+            base = i * max_levels
+            res.append(list(out[base:base + counts[i]]))
+    return res
+
+
+# ---------------------------------------------------------------------
+# wildcard match
+# ---------------------------------------------------------------------
+def topic_match(name: str, filter_: str) -> bool:
+    lib = _load()
+    if lib is None:
+        from emqx_tpu.utils import topic as T
+        return T.match(name, filter_)
+    nb, fb = name.encode(), filter_.encode()
+    return bool(lib.topic_match(nb, len(nb), fb, len(fb)))
+
+
+# ---------------------------------------------------------------------
+# replayq segment scan
+# ---------------------------------------------------------------------
+def replayq_scan(data: bytes, max_items: int = 65536
+                 ) -> list[tuple[int, int]]:
+    """(offset, length) of each complete length-prefixed item."""
+    lib = _load()
+    if lib is None:
+        out = []
+        i = 0
+        while i + 4 <= len(data) and len(out) < max_items:
+            n = int.from_bytes(data[i:i + 4], "big")
+            if i + 4 + n > len(data):
+                break
+            out.append((i + 4, n))
+            i += 4 + n
+        return out
+    arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+        if data else (ctypes.c_uint8 * 1)()
+    off = (ctypes.c_uint32 * max_items)()
+    lens = (ctypes.c_uint32 * max_items)()
+    rc = lib.replayq_scan(arr, len(data), off, lens, max_items)
+    return [(off[i], lens[i]) for i in range(rc)]

@@ -161,7 +161,7 @@ class MqttBridgeWorker:
                 return
             msg = make(f"bridge:{self.name}", pkt.qos,
                        self.receive_mountpoint + pkt.topic, pkt.payload)
-            self.node.broker.publish(msg)
+            await self.node.broker.publish_async(msg)
 
     def info(self) -> dict:
         return {"name": self.name, "state": self.state,

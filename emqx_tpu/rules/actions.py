@@ -70,7 +70,7 @@ def act_republish(node, params: dict, columns: dict, envs: dict) -> None:
                topic, payload.encode(),
                headers={"republish_by": envs.get("rule_id")})
     msg.set_header("__republished", True)
-    node.broker.publish(msg)
+    node.broker.publish_soon(msg)
 
 
 BUILTIN_ACTIONS: dict[str, Callable] = {

@@ -77,13 +77,17 @@ class ReplayQ:
                 data = f.read()
         except FileNotFoundError:
             return []
-        items, i = [], 0
-        while i + 4 <= len(data):
-            (n,) = struct.unpack(">I", data[i:i + 4])
-            if i + 4 + n > len(data):
-                break       # torn tail write: discard
-            items.append(data[i + 4:i + 4 + n])
-            i += 4 + n
+        from emqx_tpu import native
+        # torn tail writes are discarded by the scan; loop so dense
+        # segments beyond one scan's max_items are never truncated
+        items: list[bytes] = []
+        base = 0
+        while base < len(data):
+            spans = native.replayq_scan(data[base:])
+            if not spans:
+                break
+            items.extend(data[base + o:base + o + n] for o, n in spans)
+            base += spans[-1][0] + spans[-1][1]
         return items
 
     # ---- queue api ----
@@ -125,7 +129,7 @@ class ReplayQ:
                 off = 0
         if not items:
             return [], None
-        return items, (seg, off)
+        return items, (seg, off, len(items))
 
     def _seg_items_cached(self, seg: int) -> list[bytes]:
         if self._cache_seg != seg:
@@ -139,7 +143,7 @@ class ReplayQ:
             self._mem = self._mem[acked:]
             self._count = len(self._mem)
             return
-        seg, off = ref
+        seg, off, n_items = ref
         with open(self._commit_path(), "w") as f:
             f.write(f"{seg} {off}")
             f.flush()
@@ -150,7 +154,9 @@ class ReplayQ:
             except FileNotFoundError:
                 pass
         self._rseg, self._roff = seg, off
-        self._count = self._scan_count()
+        # decrement by the popped batch — a full rescan here would make
+        # every ack O(backlog bytes)
+        self._count = max(0, self._count - n_items)
 
     def count(self) -> int:
         return self._count if self.dir is not None else len(self._mem)

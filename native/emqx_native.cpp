@@ -1,0 +1,172 @@
+// Native runtime components for the TPU-native broker.
+//
+// Parity role (SURVEY.md §2.3): where the reference leans on native code
+// for hot byte-level work (jiffy's C JSON for payloads, esockd's accept
+// path, the BEAM's binary pattern matching that makes emqx_frame.erl fast),
+// this library provides the equivalents for the Python host runtime:
+//
+//   mqtt_frame_scan        batched fixed-header/varint scan that splits a
+//                          TCP read buffer into complete MQTT frames — the
+//                          {active,N} batching primitive feeding the codec
+//   topic_level_hashes     tokenize a topic on '/' and FNV-1a-64 hash each
+//                          level for the device intern table
+//   topic_hash_batch       the same over a batch of topics in one call
+//   topic_match            wildcard filter match (emqx_topic:match/2) for
+//                          host-side fast paths
+//   replayq_scan           length-prefixed segment scan with torn-tail
+//                          detection for the disk replay queue
+//
+// Build: `make -C native` -> libemqx_native.so, loaded via ctypes
+// (emqx_tpu/native.py) with pure-Python fallbacks when absent.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// MQTT frame scan
+// Returns the number of complete frames found (<= n_out); writes their
+// (offset, total_length) into out_off/out_len. *consumed = end of the
+// last complete frame. Returns -1 on a malformed varint (>4 bytes) and
+// -2 on a frame exceeding max_frame.
+// ---------------------------------------------------------------------
+int mqtt_frame_scan(const uint8_t* buf, size_t len,
+                    uint32_t* out_off, uint32_t* out_len, int n_out,
+                    uint32_t max_frame, size_t* consumed) {
+    size_t pos = 0;
+    int found = 0;
+    *consumed = 0;
+    while (pos + 2 <= len && found < n_out) {
+        // fixed header: type/flags byte + varint remaining length
+        size_t p = pos + 1;
+        uint32_t rem = 0;
+        uint32_t mult = 1;
+        int nbytes = 0;
+        bool complete_varint = false;
+        while (p < len && nbytes < 4) {
+            uint8_t b = buf[p++];
+            rem += (uint32_t)(b & 0x7F) * mult;
+            mult <<= 7;
+            ++nbytes;
+            if ((b & 0x80) == 0) { complete_varint = true; break; }
+        }
+        if (!complete_varint) {
+            if (nbytes >= 4) return -1;   // varint longer than 4 bytes
+            break;                        // need more bytes
+        }
+        size_t total = (p - pos) + rem;
+        if (max_frame && total > max_frame) return -2;
+        if (pos + total > len) break;     // incomplete body
+        out_off[found] = (uint32_t)pos;
+        out_len[found] = (uint32_t)total;
+        ++found;
+        pos += total;
+        *consumed = pos;
+    }
+    return found;
+}
+
+// ---------------------------------------------------------------------
+// Topic level hashing (FNV-1a 64) — the intern-table key function.
+// ---------------------------------------------------------------------
+static inline uint64_t fnv1a(const char* s, size_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < n; ++i) {
+        h ^= (uint8_t)s[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+int topic_level_hashes(const char* topic, size_t len,
+                       uint64_t* out, int max_levels) {
+    int levels = 0;
+    size_t start = 0;
+    for (size_t i = 0; i <= len; ++i) {
+        if (i == len || topic[i] == '/') {
+            if (levels >= max_levels) return -1;
+            out[levels++] = fnv1a(topic + start, i - start);
+            start = i + 1;
+        }
+    }
+    return levels;
+}
+
+// Batched: topics concatenated in buf with offsets/lengths. counts[i]
+// receives the level count (or 0xFF on overflow); hashes are written to
+// out[i*max_levels ...].
+int topic_hash_batch(const char* buf, const uint32_t* offs,
+                     const uint32_t* lens, int n,
+                     uint64_t* out, uint8_t* counts, int max_levels) {
+    for (int i = 0; i < n; ++i) {
+        int c = topic_level_hashes(buf + offs[i], lens[i],
+                                   out + (size_t)i * max_levels,
+                                   max_levels);
+        counts[i] = c < 0 ? 0xFF : (uint8_t)c;
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------
+// Wildcard topic match (emqx_topic:match/2 semantics):
+//   '+' one level, '#' tail (must be last), '$'-topics never match a
+//   root-level wildcard. Returns 1 match / 0 no match.
+// ---------------------------------------------------------------------
+int topic_match(const char* name, size_t name_len,
+                const char* filter, size_t filter_len) {
+    // $-topics are excluded from root wildcards
+    if (name_len > 0 && name[0] == '$' && filter_len > 0 &&
+        (filter[0] == '+' || filter[0] == '#'))
+        return 0;
+    size_t ni = 0, fi = 0;
+    while (fi < filter_len) {
+        // current filter level [fi, fe)
+        size_t fe = fi;
+        while (fe < filter_len && filter[fe] != '/') ++fe;
+        size_t flen = fe - fi;
+        if (flen == 1 && filter[fi] == '#')
+            return 1;                      // '#' swallows the rest
+        if (ni > name_len) return 0;       // name exhausted, filter not
+        // current name level [ni, ne)
+        size_t ne = ni;
+        while (ne < name_len && name[ne] != '/') ++ne;
+        if (!(flen == 1 && filter[fi] == '+')) {
+            if (ne - ni != flen ||
+                memcmp(name + ni, filter + fi, flen) != 0)
+                return 0;
+        }
+        fi = fe + 1;                       // skip '/'
+        ni = ne + 1;
+        if (fe == filter_len) {            // filter exhausted
+            return ni > name_len ? 1 : 0;  // name must be exhausted too
+        }
+    }
+    return ni > name_len ? 1 : 0;
+}
+
+// ---------------------------------------------------------------------
+// Replay-queue segment scan: length-prefixed items (>I big-endian).
+// Writes item (offset,length) pairs; a torn tail (partial item) is
+// ignored, matching ReplayQ._read_seg. Returns item count.
+// ---------------------------------------------------------------------
+int replayq_scan(const uint8_t* buf, size_t len,
+                 uint32_t* out_off, uint32_t* out_len, int n_out) {
+    size_t pos = 0;
+    int found = 0;
+    while (pos + 4 <= len && found < n_out) {
+        uint32_t n = ((uint32_t)buf[pos] << 24) |
+                     ((uint32_t)buf[pos + 1] << 16) |
+                     ((uint32_t)buf[pos + 2] << 8) |
+                     (uint32_t)buf[pos + 3];
+        if (pos + 4 + n > len) break;      // torn tail
+        out_off[found] = (uint32_t)(pos + 4);
+        out_len[found] = n;
+        ++found;
+        pos += 4 + n;
+    }
+    return found;
+}
+
+}  // extern "C"
