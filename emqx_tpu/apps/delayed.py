@@ -93,6 +93,15 @@ class DelayedPublish:
     def start(self, interval: float = 0.25) -> None:
         self._task = asyncio.ensure_future(self._run(interval))
 
+    # ---- checkpoint/resume (broker.persistence) ----
+    def pending(self) -> list[tuple[int, int, Message]]:
+        """Live (fire_ms, seq, msg) entries, cancelled ones excluded."""
+        return [(fire, seq, m) for fire, seq, m in sorted(self._heap)
+                if seq not in self._cancelled]
+
+    def restore(self, msg: Message, fire_at_ms: int) -> None:
+        heapq.heappush(self._heap, (fire_at_ms, next(self._seq), msg))
+
     # ---- mgmt API (emqx_delayed:list/delete) ----
     def list(self) -> list[dict]:
         return [{"seq": seq, "publish_at": fire, "topic": m.topic,

@@ -62,6 +62,7 @@ class Broker:
 
         self._subscribers: dict[int, Subscriber] = {}
         self._sub_meta: dict[int, str] = {}     # sid -> clientid
+        self._pub_tasks: set = set()            # in-flight publish_soon
         # filter -> {sid -> subopts}  (emqx_subscriber + emqx_suboption)
         self.subs: dict[str, dict[int, dict]] = {}
         # real filter -> {group -> SharedGroup} (emqx_shared_subscription),
@@ -195,13 +196,18 @@ class Broker:
         """Fire-and-forget publish from sync code paths (will messages,
         gateway datagrams, rule republish): schedules publish_async so
         async extension hooks (exhook) still see the message; falls back
-        to the sync path when no loop is running."""
+        to the sync path when no loop is running. Tasks are strongly held
+        until done — the loop only keeps weak refs and GC could otherwise
+        drop an in-flight publish."""
         import asyncio
         try:
-            asyncio.get_running_loop().create_task(
+            task = asyncio.get_running_loop().create_task(
                 self.publish_async(msg))
         except RuntimeError:
             self.publish(msg)
+            return
+        self._pub_tasks.add(task)
+        task.add_done_callback(self._pub_tasks.discard)
 
     def publish_batch(self, msgs: list[Message]) -> list[int]:
         """Micro-batched publish: one device match for the whole batch

@@ -1,0 +1,272 @@
+"""Checkpoint/resume, plugins, dashboard, telemetry tests.
+
+Mirrors the reference's durability posture (SURVEY.md §5.4: retained/
+delayed mnesia disc copies, session continuity) re-derived as snapshot +
+WAL, plus emqx_plugins / emqx_dashboard_admin / emqx_telemetry suites."""
+
+import asyncio
+import json
+import sys
+import types
+
+import pytest
+
+from emqx_tpu.apps.dashboard import DashboardAdmin, register_api
+from emqx_tpu.apps.delayed import DelayedPublish
+from emqx_tpu.apps.plugins import Plugins
+from emqx_tpu.apps.retainer import Retainer
+from emqx_tpu.apps.telemetry import Telemetry
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+from emqx_tpu.broker.persistence import (Persistence,
+                                         attach_retainer_journal)
+from emqx_tpu.broker.session import Session, SessionConf
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 20))
+
+
+def _node_with_apps():
+    node = Node(use_device=False)
+    node.register_app(Retainer(node).load())
+    node.register_app(DelayedPublish(node).load())
+    return node
+
+
+class TestCheckpointResume:
+    def test_snapshot_restores_everything(self, tmp_path):
+        d = str(tmp_path / "data")
+        node = _node_with_apps()
+        pers = Persistence(node, d)
+        # routes
+        node.broker.subscribe(node.broker.register(object(), "c1"),
+                              "sub/+/t")
+        node.broker.subscribe(node.broker.register(object(), "c2"),
+                              "plain/topic")
+        # retained
+        node.broker.publish(make("p", 1, "ret/1", b"keep",
+                                 flags={"retain": True}))
+        # delayed
+        node.broker.publish(make("p", 0, "$delayed/60/later", b"soon"))
+        # parked session
+        s = Session("park-1", SessionConf(session_expiry_interval=600))
+        s.subscribe("a/b", {"qos": 1})
+        node.cm.park_session("park-1", s)
+        pers.save_snapshot()
+
+        # fresh node: load the snapshot
+        node2 = _node_with_apps()
+        pers2 = Persistence(node2, d)
+        assert pers2.load_snapshot()
+        assert "sub/+/t" in node2.router.topics()
+        assert "plain/topic" in node2.router.topics()
+        ret2 = node2.get_app(Retainer)
+        assert ret2.lookup("ret/1").payload == b"keep"
+        del2 = node2.get_app(DelayedPublish)
+        assert del2.count() == 1
+        assert node2.cm.parked_count() == 1
+        sess = node2.cm._detached["park-1"]
+        assert sess.subscriptions == {"a/b": {"qos": 1}}
+
+    def test_wal_replay_after_snapshot(self, tmp_path):
+        d = str(tmp_path / "data")
+        node = _node_with_apps()
+        pers = Persistence(node, d)
+        attach_retainer_journal(node)
+        pers.save_snapshot()                # empty snapshot
+        # mutations AFTER the snapshot go to the WAL
+        node.broker.publish(make("p", 0, "wal/kept", b"v1",
+                                 flags={"retain": True}))
+        pers.journal("route_add", topic="wal/+/route")
+        # crash + restart: snapshot (empty) + WAL replay
+        node2 = _node_with_apps()
+        pers2 = Persistence(node2, d)
+        pers2.load_snapshot()
+        assert node2.get_app(Retainer).lookup("wal/kept").payload == b"v1"
+        assert "wal/+/route" in node2.router.topics()
+
+    def test_snapshot_truncates_wal(self, tmp_path):
+        d = str(tmp_path / "data")
+        node = _node_with_apps()
+        pers = Persistence(node, d)
+        attach_retainer_journal(node)
+        node.broker.publish(make("p", 0, "t/1", b"x",
+                                 flags={"retain": True}))
+        assert pers.wal.count() == 1
+        pers.save_snapshot()
+        assert pers.wal.count() == 0        # contents now in the snapshot
+        node2 = _node_with_apps()
+        Persistence(node2, d).load_snapshot()
+        assert node2.get_app(Retainer).lookup("t/1") is not None
+
+    def test_retained_delete_journaled(self, tmp_path):
+        d = str(tmp_path / "data")
+        node = _node_with_apps()
+        pers = Persistence(node, d)
+        attach_retainer_journal(node)
+        pers.save_snapshot()
+        node.broker.publish(make("p", 0, "rd/1", b"x",
+                                 flags={"retain": True}))
+        node.get_app(Retainer).delete("rd/1")
+        node2 = _node_with_apps()
+        Persistence(node2, d).load_snapshot()
+        assert node2.get_app(Retainer).lookup("rd/1") is None
+
+
+class TestPlugins:
+    def _make_module(self, name):
+        mod = types.ModuleType(name)
+        calls = []
+
+        def load(node, conf):
+            calls.append(("load", conf))
+
+            class Inst:
+                def unload(self):
+                    calls.append(("unload",))
+            return Inst()
+        mod.load = load
+        mod._calls = calls
+        sys.modules[name] = mod
+        return mod
+
+    def test_load_unload_cycle(self):
+        mod = self._make_module("fake_plugin_a")
+        node = Node(use_device=False)
+        plugins = Plugins(node, {"load": [
+            {"name": "a", "module": "fake_plugin_a",
+             "config": {"k": 1}}]})
+        assert plugins.load_all() == 1
+        assert mod._calls[0] == ("load", {"k": 1})
+        assert plugins.is_loaded("a")
+        assert plugins.list()[0]["enabled"] is True
+        assert plugins.unload("a")
+        assert mod._calls[-1] == ("unload",)
+        assert not plugins.is_loaded("a")
+        assert not plugins.unload("a")
+
+    def test_bad_plugin_does_not_block_boot(self):
+        node = Node(use_device=False)
+        plugins = Plugins(node, {"load": [
+            {"name": "bad", "module": "no_such_module_xyz"},
+        ]})
+        assert plugins.load_all() == 0   # swallowed, boot continues
+
+    def test_disabled_not_loaded(self):
+        self._make_module("fake_plugin_b")
+        node = Node(use_device=False)
+        plugins = Plugins(node, {"load": [
+            {"name": "b", "module": "fake_plugin_b", "enabled": False}]})
+        assert plugins.load_all() == 0
+        assert plugins.list()[0]["enabled"] is False
+
+
+class TestDashboard:
+    def test_default_admin_and_user_crud(self):
+        node = Node(use_device=False)
+        admin = DashboardAdmin(node)
+        assert admin.check("admin", "public")
+        assert not admin.check("admin", "wrong")
+        admin.add_user("ops", "secret1", "ops user")
+        assert admin.check("ops", "secret1")
+        with pytest.raises(ValueError):
+            admin.add_user("ops", "x")
+        assert admin.change_password("ops", "secret1", "secret2")
+        assert admin.check("ops", "secret2")
+        assert admin.remove_user("ops")
+        with pytest.raises(ValueError):
+            admin.remove_user("admin")   # last admin protected
+
+    def test_token_flow(self):
+        node = Node(use_device=False)
+        admin = DashboardAdmin(node)
+        assert admin.sign_token("admin", "bad") is None
+        tok = admin.sign_token("admin", "public")
+        assert admin.verify_token(tok) == "admin"
+        assert admin.auth_check("__bearer__", tok)
+        assert admin.destroy_token(tok)
+        assert admin.verify_token(tok) is None
+
+    def test_http_login_and_overview(self, loop):
+        import base64
+
+        from emqx_tpu.mgmt.httpd import HttpServer
+        node = Node(use_device=False)
+        admin = DashboardAdmin(node)
+        srv = HttpServer("127.0.0.1", 0, auth_check=admin.auth_check,
+                         auth_exempt=("/api/v5/login",))
+        register_api(srv, node, admin)
+
+        async def req(method, path, body=None, bearer=None):
+            r, w = await asyncio.open_connection("127.0.0.1", srv.port)
+            data = json.dumps(body).encode() if body is not None else b""
+            hdrs = [f"{method} {path} HTTP/1.1", "host: x",
+                    f"content-length: {len(data)}", "connection: close"]
+            if bearer:
+                hdrs.append(f"authorization: Bearer {bearer}")
+            w.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + data)
+            await w.drain()
+            raw = await r.read(-1)
+            w.close()
+            head, _, payload = raw.partition(b"\r\n\r\n")
+            return int(head.split()[1]), \
+                json.loads(payload) if payload else None
+
+        async def go():
+            await srv.start()
+            st, _ = await req("GET", "/api/v5/overview")
+            assert st == 401
+            st, body = await req("POST", "/api/v5/login",
+                                 {"username": "admin",
+                                  "password": "public"})
+            assert st == 200 and body["token"]
+            tok = body["token"]
+            st, ov = await req("GET", "/api/v5/overview", bearer=tok)
+            assert st == 200 and ov["node"] == node.name
+            st, _ = await req("POST", "/api/v5/logout", bearer=tok)
+            assert st == 204
+            st, _ = await req("GET", "/api/v5/overview", bearer=tok)
+            assert st == 401
+            await srv.stop()
+        run(loop, go())
+
+
+class TestTelemetry:
+    def test_report_shape_and_disabled_by_default(self):
+        node = Node(use_device=False)
+        Plugins(node, {"load": []})
+        tel = Telemetry(node)
+        assert tel.enabled is False          # opt-in, like the reference
+        rep = tel.get_telemetry()
+        assert rep["license"]["edition"] == "opensource"
+        assert "uuid" in rep and rep["emqx_version"]
+        assert rep["num_clients"] == 0
+
+    def test_report_posts_to_endpoint(self, loop):
+        from emqx_tpu.mgmt.httpd import HttpServer
+        node = Node(use_device=False)
+        received = []
+        srv = HttpServer("127.0.0.1", 0)
+
+        async def sink(req):
+            received.append(json.loads(req.body))
+            return 200, {}
+        srv.route("POST", "/telemetry", sink)
+
+        async def go():
+            await srv.start()
+            tel = Telemetry(node, {
+                "enable": True,
+                "url": f"http://127.0.0.1:{srv.port}/telemetry"})
+            ok = await tel.report_once()
+            assert ok and received[0]["uuid"] == tel.uuid
+            await srv.stop()
+        run(loop, go())
