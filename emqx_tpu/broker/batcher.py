@@ -1,0 +1,126 @@
+"""Publish micro-batcher: the cross-connection batching window.
+
+The reference amortizes per-packet costs with `{active, N}` socket reads
+inside ONE connection (emqx_connection.erl:111,454-464 — SURVEY.md P10);
+the TPU design needs batching ACROSS connections so the fused device route
+step sees a real batch. This is that window: channels submit PUBLISHes here
+and await their delivery counts; a drain task accumulates messages for at
+most `window_us` (or until `max_batch`), runs the `message.publish` hook
+fold per message (concurrently — exhook gRPC etc. stay async), then routes
+the batch:
+
+- batches >= `device_min_batch` with a built device snapshot go through
+  DeviceRouteEngine.route_batch (the fused match+fanout+shared step);
+- small batches take the host per-message path — the dedicated small-batch
+  path of SURVEY.md §7 hard-part 2, keeping p99 low at trickle rates.
+
+The drain task lives only while the queue is non-empty (spawned by submit,
+exits when drained), so an idle broker holds no background task.
+
+Ordering: submissions are FIFO; the drain processes whole batches in
+arrival order, and within a batch messages are consumed in order, so MQTT's
+per-publisher-per-topic ordering is preserved.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Optional
+
+from emqx_tpu.broker.message import Message
+
+
+class PublishBatcher:
+    def __init__(self, node, engine, *, window_us: int = 200,
+                 max_batch: int = 1024, device_min_batch: int = 4,
+                 max_pending: Optional[int] = None):
+        self.node = node
+        self.engine = engine
+        self.window_s = window_us / 1e6
+        self.max_batch = max_batch
+        self.device_min_batch = device_min_batch
+        # fire-and-forget backpressure bound: beyond this, enqueue() refuses
+        # and the caller must await submit() (stalling its read loop)
+        self.max_pending = max_pending or 8 * max_batch
+        self._queue: deque = deque()
+        self._task: Optional[asyncio.Task] = None
+
+    # ---- producer side --------------------------------------------------
+    async def submit(self, msg: Message) -> int:
+        """Queue one PUBLISH; resolves to its delivery count."""
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append((msg, fut))
+        self._kick()
+        return await fut
+
+    def enqueue(self, msg: Message) -> bool:
+        """Fire-and-forget submit (QoS0: the publisher owes no ack, so one
+        connection can pipeline publishes into a single batch window).
+        Returns False when the queue is over the backpressure bound — the
+        caller must fall back to awaiting submit()."""
+        if len(self._queue) >= self.max_pending:
+            return False
+        self._queue.append((msg, None))
+        self._kick()
+        return True
+
+    def _kick(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def stop(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        self._task = None
+
+    # ---- drain loop (alive only while the queue is non-empty) -----------
+    async def _drain(self) -> None:
+        while self._queue:
+            # adaptive window: the first message opened it; give concurrent
+            # connections one short beat to pile on unless already full
+            if len(self._queue) < self.max_batch and self.window_s > 0:
+                await asyncio.sleep(self.window_s)
+            batch = []
+            while self._queue and len(batch) < self.max_batch:
+                batch.append(self._queue.popleft())
+            try:
+                await self._process(batch)
+            except Exception as e:  # route failure must not hang publishers
+                for _m, fut in batch:
+                    if fut is not None and not fut.done():
+                        fut.set_exception(e)
+
+    async def _process(self, batch: list) -> None:
+        broker = self.node.broker
+        # message.publish hook fold, concurrently across the batch
+        folded = await asyncio.gather(*[
+            broker.hooks.run_fold_async("message.publish", (), m)
+            for m, _f in batch])
+        live_idx: list[int] = []
+        live: list[Message] = []
+        for i, m in enumerate(folded):
+            if m is None or m.get_header("allow_publish") is False:
+                continue
+            broker.metrics.inc("messages.publish")
+            live_idx.append(i)
+            live.append(m)
+
+        counts = [0] * len(batch)
+        if live:
+            routed = None
+            if (self.engine is not None
+                    and len(live) >= self.device_min_batch):
+                routed = self.engine.route_batch(live)
+            if routed is None:
+                routed = [broker._route(m, broker.router.match(m.topic))
+                          for m in live]
+            for j, i in enumerate(live_idx):
+                counts[i] = routed[j]
+        for i, (_m, fut) in enumerate(batch):
+            if fut is not None and not fut.done():
+                fut.set_result(counts[i])

@@ -369,9 +369,10 @@ class Channel:
         self.node.metrics.inc_msg_recv(pkt.qos)
 
         if pkt.qos == C.QOS_0:
-            await self.node.broker.publish_async(msg)
+            if not self.node.publish_nowait(msg):
+                await self.node.publish_async(msg)
         elif pkt.qos == C.QOS_1:
-            n = await self.node.broker.publish_async(msg)
+            n = await self.node.publish_async(msg)
             rc = C.RC_SUCCESS if n else C.RC_NO_MATCHING_SUBSCRIBERS
             if self.proto_ver < C.MQTT_V5:
                 rc = C.RC_SUCCESS
@@ -382,7 +383,7 @@ class Channel:
             # method (emqx_session:publish/3); avoids buffering payloads
             try:
                 self.session.publish_qos2(pkt.packet_id)
-                n = await self.node.broker.publish_async(msg)
+                n = await self.node.publish_async(msg)
                 rc = C.RC_SUCCESS if n or self.proto_ver < C.MQTT_V5 \
                     else C.RC_NO_MATCHING_SUBSCRIBERS
                 self._send([P.Pubrec(packet_id=pkt.packet_id,

@@ -25,7 +25,8 @@ from emqx_tpu.broker.router import Router
 
 class Node:
     def __init__(self, config: Optional[dict] = None, *,
-                 use_device: bool = False, name: str = "emqx_tpu@127.0.0.1"):
+                 use_device: Optional[bool] = None,
+                 name: str = "emqx_tpu@127.0.0.1"):
         from emqx_tpu.broker.config import Config
         self.name = name
         self.config = config if hasattr(config, "get_zone") else Config(config)
@@ -33,6 +34,10 @@ class Node:
         self.metrics = Metrics()
         self.stats = Stats()
         perf = self.config.get("broker") or {}
+        if use_device is None:
+            # default-on: the fused device route step IS the serving path
+            # wherever a jax device exists (real TPU or the CPU backend)
+            use_device = bool(perf.get("device_route", True))
         self.router = Router(
             use_device=use_device,
             rebuild_threshold=perf.get("rebuild_threshold", 256),
@@ -43,6 +48,21 @@ class Node:
                                      "round_robin"),
             shared_dispatch_ack=perf.get("shared_dispatch_ack_enabled",
                                          False))
+        self.device_engine = None
+        self.publish_batcher = None
+        if use_device:
+            from emqx_tpu.broker.batcher import PublishBatcher
+            from emqx_tpu.broker.device_engine import DeviceRouteEngine
+            self.device_engine = DeviceRouteEngine(
+                self,
+                rebuild_threshold=perf.get("rebuild_threshold", 256),
+                fanout_cap=perf.get("device_fanout_cap", 128),
+                slot_cap=perf.get("device_slot_cap", 16))
+            self.publish_batcher = PublishBatcher(
+                self, self.device_engine,
+                window_us=perf.get("batch_window_us", 200),
+                max_batch=perf.get("max_publish_batch", 1024),
+                device_min_batch=perf.get("device_min_batch", 4))
         self.cm = ConnectionManager()
         self.cm.broker = self.broker
         self.banned = Banned()
@@ -90,6 +110,23 @@ class Node:
     # ---- facade (emqx.erl) ----
     def publish(self, msg: Message) -> int:
         return self.broker.publish(msg)
+
+    async def publish_async(self, msg: Message) -> int:
+        """The channel PUBLISH entry: batched through the device route
+        pipeline when enabled, else the host per-message path."""
+        if self.publish_batcher is not None:
+            return await self.publish_batcher.submit(msg)
+        return await self.broker.publish_async(msg)
+
+    def publish_nowait(self, msg: Message) -> bool:
+        """Fire-and-forget PUBLISH (QoS0 path): pipelines into the batch
+        window without serializing the caller's read loop. Returns False
+        when not accepted (no batcher, or backpressure bound hit) — the
+        caller must `await publish_async` instead, which both preserves
+        per-publisher ordering and stalls an overloading read loop."""
+        if self.publish_batcher is not None:
+            return self.publish_batcher.enqueue(msg)
+        return False
 
     def topics(self) -> list[str]:
         return self.router.topics()

@@ -59,6 +59,9 @@ class Broker:
         # set by cluster.ClusterNode when this broker joins a cluster:
         # replicates routes/shared-members and forwards cross-node
         self.cluster = None
+        # set by DeviceRouteEngine: membership-churn listener for the
+        # compiled device snapshot
+        self.device_engine = None
 
         self._subscribers: dict[int, Subscriber] = {}
         self._sub_meta: dict[int, str] = {}     # sid -> clientid
@@ -102,6 +105,8 @@ class Broker:
                 self.router.add_route(real)
             if self.cluster:
                 self.cluster.shared_join(real, group, sid)
+            if self.device_engine:
+                self.device_engine.note_member_change(real, group)
         else:
             fsubs = self.subs.setdefault(real, {})
             fsubs[sid] = opts
@@ -109,6 +114,8 @@ class Broker:
                 self.router.add_route(real)
                 if self.cluster:
                     self.cluster.local_route_add(real)
+            if self.device_engine:
+                self.device_engine.note_member_change(real, None)
 
     def unsubscribe(self, sid: int, topic_filter: str) -> bool:
         real, opts = T.parse(topic_filter)
@@ -123,6 +130,8 @@ class Broker:
                 g.sticky = None
             if self.cluster:
                 self.cluster.shared_leave(real, group, sid)
+            if self.device_engine:
+                self.device_engine.note_member_change(real, group)
             if not g.members:
                 del groups[group]
                 if not groups:
@@ -138,6 +147,8 @@ class Broker:
             del self.subs[real]
             if not self._has_any_sub(real):
                 self._route_del(real)
+        if self.device_engine:
+            self.device_engine.note_member_change(real, None)
         return True
 
     def _route_del(self, real: str) -> None:
@@ -210,7 +221,7 @@ class Broker:
         task.add_done_callback(self._pub_tasks.discard)
 
     def publish_batch(self, msgs: list[Message]) -> list[int]:
-        """Micro-batched publish: one device match for the whole batch
+        """Micro-batched publish: one device route step for the whole batch
         (the {active,N}-window analog, SURVEY.md P10)."""
         live: list[Message] = []
         for m in msgs:
@@ -221,10 +232,16 @@ class Broker:
                 self.metrics.inc("messages.publish")
                 live.append(mm)
         idx = [i for i, m in enumerate(live) if m is not None]
-        matched = self.router.match_batch([live[i].topic for i in idx])
         counts = [0] * len(msgs)
+        routed = None
+        if self.device_engine is not None and idx:
+            routed = self.device_engine.route_batch([live[i] for i in idx])
+        if routed is None:
+            matched = self.router.match_batch([live[i].topic for i in idx])
+            routed = [self._route(live[i], matched[j])
+                      for j, i in enumerate(idx)]
         for j, i in enumerate(idx):
-            counts[i] = self._route(live[i], matched[j])
+            counts[i] = routed[j]
         return counts
 
     def _route(self, msg: Message, filters: list[str]) -> int:

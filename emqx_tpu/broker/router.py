@@ -54,6 +54,10 @@ class Router:
         self._next_fid = 0
         self.host_trie = HostTrie()
 
+        # filter-universe change listener (DeviceRouteEngine): called with
+        # (topic_filter, added: bool) after every successful mutation
+        self.on_route_change = None
+
         # device snapshot
         self._tables: Optional[TrieTables] = None
         self._built_row_to_filter: list[str] = []   # device row idx -> filter
@@ -69,6 +73,8 @@ class Router:
             if topic_filter in self.exact:
                 return False
             self.exact.add(topic_filter)
+            if self.on_route_change:
+                self.on_route_change(topic_filter, True)
             return True
         if topic_filter in self.wildcards:
             return False
@@ -82,6 +88,8 @@ class Router:
         self._delta_trie.insert(words, fid)
         self._delta_fids[fid] = topic_filter
         self._delta_count += 1
+        if self.on_route_change:
+            self.on_route_change(topic_filter, True)
         return True
 
     def delete_route(self, topic_filter: str) -> bool:
@@ -89,6 +97,8 @@ class Router:
             if topic_filter not in self.exact:
                 return False
             self.exact.discard(topic_filter)
+            if self.on_route_change:
+                self.on_route_change(topic_filter, False)
             return True
         fid = self.wildcards.pop(topic_filter, None)
         if fid is None:
@@ -100,6 +110,8 @@ class Router:
             self._delta_trie.delete(words)
             del self._delta_fids[fid]
         self._delta_count += 1
+        if self.on_route_change:
+            self.on_route_change(topic_filter, False)
         return True
 
     def has_route(self, topic_filter: str) -> bool:

@@ -48,6 +48,7 @@ class RouteResult(NamedTuple):
     rows: jax.Array           # [B, D] normal delivery session rows
     opts: jax.Array           # [B, D] packed subopts
     fan_counts: jax.Array     # [B]
+    shared_sids: jax.Array    # [B, K] matched shared-slot ids (-1 pad)
     shared_rows: jax.Array    # [B, K] shared picks (session rows)
     shared_opts: jax.Array    # [B, K]
     overflow: jax.Array       # [B] any capacity overflow → host fallback
@@ -67,7 +68,7 @@ def post_match(subs: SubTable, mr: MatchResult, cursors: jax.Array,
     return RouteResult(
         matches=mr.matches, match_counts=mr.counts,
         rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
-        shared_rows=sp.rows, shared_opts=sp.opts,
+        shared_sids=sids, shared_rows=sp.rows, shared_opts=sp.opts,
         overflow=overflow, new_cursors=sp.new_cursors, occur=sp.occur)
 
 

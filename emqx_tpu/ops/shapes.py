@@ -105,15 +105,16 @@ def _place(home1: np.ndarray, home2: np.ndarray, nb: int):
     leftover is empty on success.
     """
     F = len(home1)
-    pos_tab = np.full(nb * BK, -1, np.int64)
-    pref = (home1 * 0x9E37 + home2 * 0x85EB)  # per-item probe-order seed
-    pending = np.arange(F)
+    h1_32 = home1.astype(np.int32)
+    h2_32 = home2.astype(np.int32)
+    pos_tab = np.full(nb * BK, -1, np.int32)
+    pref = (h1_32 * 0x9E37 + h2_32 * 0x85EB)  # per-item probe-order seed
+    pending = np.arange(F, dtype=np.int32)
     for r in range(2 * BK):  # one round per candidate position
-
         if len(pending) == 0:
             break
-        k = (pref[pending] + r) % (2 * BK)
-        choice = np.where(k & 1 == 0, home1[pending], home2[pending])
+        k = (pref[pending] + r) & (2 * BK - 1)
+        choice = np.where(k & 1 == 0, h1_32[pending], h2_32[pending])
         cand = choice * BK + (k >> 1)
         free = pos_tab[cand] == -1
         cf, pf = cand[free], pending[free]
@@ -214,10 +215,10 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
     arangeF = np.arange(F)
     has_hash = (words[arangeF, lens - 1] == HASH).astype(np.int64)
     slen = lens - has_hash
-    is_plus = (words == PLUS)
-    lvl = np.arange(L)[None, :]
-    plus_mask = ((is_plus & (lvl < slen[:, None])).astype(np.int64)
-                 << lvl).sum(axis=1)
+    # per-level accumulation: avoids materializing an [F, L] int64 temp
+    plus_mask = np.zeros(F, np.int64)
+    for l in range(min(L, int(slen.max(initial=0)))):
+        plus_mask |= ((words[:, l] == PLUS) & (l < slen)).astype(np.int64) << l
 
     sig = plus_mask | (slen << 24) | (has_hash << 60)
     uniq, inv = np.unique(sig, return_inverse=True)

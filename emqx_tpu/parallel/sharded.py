@@ -98,14 +98,15 @@ def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
         res = RouteResult(
             matches=mr.matches, match_counts=mr.counts,
             rows=fr.rows, opts=fr.opts, fan_counts=fr.counts,
-            shared_rows=sp.rows, shared_opts=sp.opts, overflow=overflow,
-            new_cursors=new_cursors, occur=total_occur)
+            shared_sids=sids, shared_rows=sp.rows, shared_opts=sp.opts,
+            overflow=overflow, new_cursors=new_cursors, occur=total_occur)
         # per-topic outputs gain a 'route' axis at dim 1; cursor state keeps
         # its leading 'route' axis
         return RouteResult(
             matches=res.matches[:, None], match_counts=res.match_counts[:, None],
             rows=res.rows[:, None], opts=res.opts[:, None],
             fan_counts=res.fan_counts[:, None],
+            shared_sids=res.shared_sids[:, None],
             shared_rows=res.shared_rows[:, None],
             shared_opts=res.shared_opts[:, None],
             overflow=res.overflow[:, None],
@@ -116,7 +117,8 @@ def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
     out_specs = RouteResult(
         matches=per_topic_spec, match_counts=per_topic_spec,
         rows=per_topic_spec, opts=per_topic_spec, fan_counts=per_topic_spec,
-        shared_rows=per_topic_spec, shared_opts=per_topic_spec,
+        shared_sids=per_topic_spec, shared_rows=per_topic_spec,
+        shared_opts=per_topic_spec,
         overflow=per_topic_spec, new_cursors=table_spec, occur=table_spec)
 
     mapped = jax.shard_map(

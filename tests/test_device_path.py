@@ -1,0 +1,253 @@
+"""The device route engine as the LIVE serving path.
+
+Round 2's flagship requirement (VERDICT.md next-round #2): PUBLISHes flowing
+through real TCP connections must be matched + fanned out by the fused
+device route step (models.router_engine), with RouteResult rows driving the
+actual deliveries — asserted via the `messages.routed.device` counter — and
+stale-snapshot cases (membership churn, new filters) handled correctly.
+Parity target: emqx_broker.erl:199-308 publish/dispatch semantics.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+
+
+class Sink:
+    """Fake subscriber recording deliveries."""
+
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, topic_filter, msg):
+        self.got.append((topic_filter, msg.topic, msg.payload,
+                         msg.headers.get("subopts", {})))
+        return True
+
+
+def mkmsg(topic, payload=b"x", qos=0, from_="pub"):
+    return make(from_, qos, topic, payload)
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    assert n.device_engine is not None  # default-on
+    return n
+
+
+class TestEngineDirect:
+    """DeviceRouteEngine.route_batch consumed into deliveries (no sockets)."""
+
+    def test_wildcard_and_exact_device_rows(self, node):
+        b = node.broker
+        s1, s2, s3 = Sink(), Sink(), Sink()
+        sid1 = b.register(s1, "c1")
+        sid2 = b.register(s2, "c2")
+        sid3 = b.register(s3, "c3")
+        b.subscribe(sid1, "dev/+/temp", {"qos": 1})
+        b.subscribe(sid2, "dev/7/temp", {"qos": 0})
+        b.subscribe(sid3, "exact/topic", {"qos": 2})
+
+        msgs = [mkmsg("dev/7/temp"), mkmsg("exact/topic"),
+                mkmsg("dev/9/temp"), mkmsg("none/match")]
+        counts = node.device_engine.route_batch(msgs)
+        assert counts == [2, 1, 1, 0]
+        assert sorted(t for _f, t, _p, _o in s1.got) == \
+            ["dev/7/temp", "dev/9/temp"]
+        assert [t for _f, t, _p, _o in s2.got] == ["dev/7/temp"]
+        assert [t for _f, t, _p, _o in s3.got] == ["exact/topic"]
+        # subopts survive the packed-byte round trip
+        assert s1.got[0][3]["qos"] == 1
+        assert s3.got[0][3]["qos"] == 2
+        assert node.metrics.val("messages.routed.device") == 4
+        assert node.metrics.val("routing.device.batches") == 1
+        assert node.metrics.val("messages.dropped.no_subscribers") == 1
+
+    def test_membership_churn_goes_host(self, node):
+        b = node.broker
+        s1 = Sink()
+        sid1 = b.register(s1, "c1")
+        b.subscribe(sid1, "t/+", {"qos": 0})
+        assert node.device_engine.route_batch([mkmsg("t/1")]) == [1]
+        dev0 = node.metrics.val("messages.routed.device")
+
+        # new member on a built filter -> filter dirty -> host dict path
+        s2 = Sink()
+        sid2 = b.register(s2, "c2")
+        b.subscribe(sid2, "t/+", {"qos": 1})
+        assert node.device_engine.route_batch([mkmsg("t/2")]) == [2]
+        assert [t for _f, t, _p, _o in s2.got] == ["t/2"]
+        assert len(s1.got) == 2
+        assert node.metrics.val("messages.routed.device") == dev0
+
+        # unsubscribe -> still dirty -> removed member gets nothing
+        b.unsubscribe(sid1, "t/+")
+        assert node.device_engine.route_batch([mkmsg("t/3")]) == [1]
+        assert len(s1.got) == 2
+        assert len(s2.got) == 2
+
+    def test_new_filter_delta_path(self, node):
+        b = node.broker
+        s1 = Sink()
+        sid1 = b.register(s1, "c1")
+        b.subscribe(sid1, "a/b", {"qos": 0})
+        assert node.device_engine.route_batch([mkmsg("a/b")]) == [1]
+
+        s2 = Sink()
+        sid2 = b.register(s2, "c2")
+        b.subscribe(sid2, "fresh/#", {"qos": 0})
+        counts = node.device_engine.route_batch(
+            [mkmsg("fresh/x/y"), mkmsg("a/b")])
+        assert counts == [1, 1]
+        assert [t for _f, t, _p, _o in s2.got] == ["fresh/x/y"]
+        assert node.device_engine.stats()["delta_filters"] == 1
+
+    def test_rebuild_after_threshold(self, node):
+        node.device_engine.rebuild_threshold = 4
+        b = node.broker
+        s1 = Sink()
+        sid1 = b.register(s1, "c1")
+        b.subscribe(sid1, "base/t", {"qos": 0})
+        node.device_engine.route_batch([mkmsg("base/t")])
+        for i in range(5):
+            b.subscribe(sid1, f"extra/{i}", {"qos": 0})
+        assert node.device_engine.staleness() >= 4
+        node.device_engine.route_batch([mkmsg("extra/3")])
+        assert node.device_engine.staleness() == 0   # rebuilt
+        assert node.device_engine.stats()["delta_filters"] == 0
+        assert len([x for x in s1.got if x[1] == "extra/3"]) == 1
+        assert node.metrics.val("routing.device.rebuilds") >= 2
+
+    def test_shared_round_robin_device_picks(self, node):
+        b = node.broker
+        sinks = [Sink() for _ in range(3)]
+        sids = [b.register(s, f"m{i}") for i, s in enumerate(sinks)]
+        for sid in sids:
+            b.subscribe(sid, "$share/g/job/q", {"qos": 1})
+        msgs = [mkmsg("job/q", str(i).encode()) for i in range(6)]
+        counts = node.device_engine.route_batch(msgs)
+        assert counts == [1] * 6
+        per = [len(s.got) for s in sinks]
+        assert sorted(per) == [2, 2, 2]          # strict round-robin
+        assert all(o.get("share") == "g"
+                   for s in sinks for _f, _t, _p, o in s.got)
+        # cursors persist across batches: next 3 go one to each member
+        node.device_engine.route_batch(
+            [mkmsg("job/q", b"n1"), mkmsg("job/q", b"n2"),
+             mkmsg("job/q", b"n3")])
+        assert sorted(len(s.got) for s in sinks) == [3, 3, 3]
+
+    def test_shared_dirty_slot_host_pick(self, node):
+        b = node.broker
+        s1, s2 = Sink(), Sink()
+        sid1, sid2 = b.register(s1, "m1"), b.register(s2, "m2")
+        b.subscribe(sid1, "$share/g/t", {"qos": 0})
+        node.device_engine.route_batch([mkmsg("t")])
+        # membership change dirties the slot -> host pick sees new member
+        b.subscribe(sid2, "$share/g/t", {"qos": 0})
+        counts = node.device_engine.route_batch(
+            [mkmsg("t") for _ in range(4)])
+        assert counts == [1] * 4
+        assert len(s1.got) + len(s2.got) == 5
+        assert len(s2.got) >= 1
+
+    def test_new_group_on_built_filter(self, node):
+        b = node.broker
+        s1, s2 = Sink(), Sink()
+        sid1, sid2 = b.register(s1, "m1"), b.register(s2, "m2")
+        b.subscribe(sid1, "t/x", {"qos": 0})
+        node.device_engine.route_batch([mkmsg("t/x")])
+        b.subscribe(sid2, "$share/g2/t/x", {"qos": 0})
+        counts = node.device_engine.route_batch([mkmsg("t/x")])
+        assert counts == [2]
+        assert len(s2.got) == 1
+
+    def test_overflow_falls_back_host(self, node):
+        node.device_engine.fanout_cap = 4   # force tiny capacity
+        b = node.broker
+        sinks = [Sink() for _ in range(8)]
+        for i, s in enumerate(sinks):
+            b.subscribe(b.register(s, f"c{i}"), "big/+", {"qos": 0})
+        counts = node.device_engine.route_batch(
+            [mkmsg("big/t"), mkmsg("big/u")])
+        assert counts == [8, 8]
+        assert all(len(s.got) == 2 for s in sinks)
+        assert node.metrics.val("routing.device.host_fallback") == 2
+
+    def test_deep_topic_falls_back_host(self, node):
+        b = node.broker
+        s = Sink()
+        b.subscribe(b.register(s, "c"), "deep/#", {"qos": 0})
+        deep = "deep/" + "/".join(str(i) for i in range(25))
+        assert node.device_engine.route_batch([mkmsg(deep)]) == [1]
+        assert len(s.got) == 1
+
+    def test_rich_subopts_host_path(self, node):
+        b = node.broker
+        s = Sink()
+        sid = b.register(s, "c")
+        b.subscribe(sid, "r/+", {"qos": 1, "subid": 7})
+        assert node.device_engine.route_batch([mkmsg("r/1")]) == [1]
+        # subid must survive (packed byte cannot carry it -> host dict)
+        assert s.got[0][3].get("subid") == 7
+
+    def test_trie_backend_when_many_shapes(self, node):
+        node.device_engine.shape_cap = 2
+        b = node.broker
+        s = Sink()
+        sid = b.register(s, "c")
+        for f in ["a", "a/b", "a/+/c", "+/b/#", "x/y/z/w"]:
+            b.subscribe(sid, f, {"qos": 0})
+        # 'a/b' matches both the exact filter and '+/b/#' ('#' = zero levels)
+        assert node.device_engine.route_batch([mkmsg("a/b")]) == [2]
+        assert node.device_engine.stats()["backend"] == "trie"
+        assert sorted(f for f, _t, _p, _o in s.got) == ["+/b/#", "a/b"]
+
+
+class TestEndToEnd:
+    """Real TCP clients; concurrent publishes form a device batch."""
+
+    def test_concurrent_publishes_routed_on_device(self):
+        from emqx_tpu.broker.connection import Listener
+        from emqx_tpu.client import Client
+
+        loop = asyncio.new_event_loop()
+        try:
+            node = Node()
+            listener = Listener(node, bind="127.0.0.1", port=0)
+            loop.run_until_complete(listener.start())
+
+            async def go():
+                sub = Client(port=listener.port, clientid="sub")
+                await sub.connect()
+                await sub.subscribe("bench/+/t", qos=1)
+                pubs = []
+                for i in range(8):
+                    c = Client(port=listener.port, clientid=f"pub{i}")
+                    await c.connect()
+                    pubs.append(c)
+                # concurrent QoS1 publishes land in one batch window
+                await asyncio.gather(*[
+                    c.publish(f"bench/{i}/t", b"p%d" % i, qos=1)
+                    for i, c in enumerate(pubs)])
+                got = []
+                for _ in range(8):
+                    got.append(await asyncio.wait_for(
+                        sub.messages.get(), 10))
+                for c in pubs:
+                    await c.disconnect()
+                await sub.disconnect()
+                return got
+
+            got = loop.run_until_complete(asyncio.wait_for(go(), 30))
+            assert sorted(m.topic for m in got) == \
+                sorted(f"bench/{i}/t" for i in range(8))
+            assert node.metrics.val("messages.routed.device") >= 8
+            assert node.metrics.val("routing.device.batches") >= 1
+            loop.run_until_complete(listener.stop())
+        finally:
+            loop.close()
