@@ -186,9 +186,21 @@ class Channel:
 
         if self.mqtt.get("use_username_as_clientid") and pkt.username:
             clientid = pkt.username
+        # TLS peer-cert enrichment (emqx_channel peer_cert_as_username/
+        # clientid zone opts; cert fields via utils.tls.cert_field)
+        username = pkt.username
+        peercert = self.conninfo.get("peercert")
+        if peercert:
+            from emqx_tpu.utils.tls import cert_field
+            src = self.mqtt.get("peer_cert_as_username")
+            if src:
+                username = cert_field(peercert, src) or username
+            src = self.mqtt.get("peer_cert_as_clientid")
+            if src:
+                clientid = cert_field(peercert, src) or clientid
         self.clientid = clientid
         self.clientinfo = {
-            "clientid": clientid, "username": pkt.username,
+            "clientid": clientid, "username": username,
             "peername": self.conninfo.get("peername"),
             "sockname": self.conninfo.get("sockname"),
             "proto_ver": pkt.proto_ver, "proto_name": pkt.proto_name,

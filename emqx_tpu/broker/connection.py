@@ -36,11 +36,14 @@ class Connection:
         self.writer = writer
         peer = writer.get_extra_info("peername") or ("?", 0)
         sock = writer.get_extra_info("sockname") or ("?", 0)
+        from emqx_tpu.utils.tls import peer_cert_info
+        peercert = peer_cert_info(writer)
         self.parser = FrameParser(
             max_size=node.config.mqtt(zone).get("max_packet_size"),
             strict=node.config.mqtt(zone).get("strict_mode", False))
         self.channel = Channel(
-            node, {"peername": peer, "sockname": sock, "zone": zone},
+            node, {"peername": peer, "sockname": sock, "zone": zone,
+                   "peercert": peercert},
             send=self._send_packets, close=self._request_close)
         self.last_rx = time.monotonic()
         self._closing: Optional[str] = None
@@ -176,16 +179,20 @@ class Connection:
 
 
 class Listener:
-    """One TCP listener (emqx_listeners:start_listener/3)."""
+    """One TCP/TLS listener (emqx_listeners:start_listener/3; ssl opts per
+    emqx_listeners.erl:126-129 + emqx_schema ssl block via utils.tls)."""
 
     def __init__(self, node, *, bind: str = "0.0.0.0", port: int = 1883,
                  zone: Optional[str] = None, max_connections: int = 1024000,
-                 name: str = "tcp:default"):
+                 name: str = "tcp:default", ssl_opts: Optional[dict] = None):
         self.node = node
         self.bind = bind
         self.port = port
         self.zone = zone
         self.name = name
+        self.ssl_opts = ssl_opts
+        if ssl_opts and name == "tcp:default":
+            self.name = "ssl:default"
         self.max_connections = max_connections
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.Task] = set()
@@ -216,8 +223,12 @@ class Listener:
             self._conns.discard(task)
 
     async def start(self) -> None:
+        ssl_ctx = None
+        if self.ssl_opts:
+            from emqx_tpu.utils.tls import make_server_context
+            ssl_ctx = make_server_context(self.ssl_opts)
         self._server = await asyncio.start_server(
-            self._on_client, self.bind, self.port)
+            self._on_client, self.bind, self.port, ssl=ssl_ctx)
         if self.port == 0:   # ephemeral port for tests
             self.port = self._server.sockets[0].getsockname()[1]
         log.info("listener %s started on %s:%d", self.name, self.bind,

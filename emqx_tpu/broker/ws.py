@@ -112,26 +112,36 @@ class _WsWriter:
 
 
 class WsListener:
-    """Parity: the ws/wss listener entry of emqx_listeners."""
+    """Parity: the ws/wss listener entry of emqx_listeners (wss = the same
+    RFC6455 server over a TLS transport, emqx_listeners.erl:132-138)."""
 
     protocol = "mqtt:ws"
 
     def __init__(self, node, *, bind: str = "0.0.0.0", port: int = 8083,
                  path: str = "/mqtt", zone: Optional[str] = None,
-                 max_connections: int = 1024000):
+                 max_connections: int = 1024000,
+                 ssl_opts: Optional[dict] = None):
         self.node = node
         self.bind = bind
         self.port = port
         self.path = path
         self.zone = zone
+        self.ssl_opts = ssl_opts
+        if ssl_opts:
+            self.protocol = "mqtt:wss"
         self.max_connections = max_connections
         self.current_conns = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.Task] = set()
 
     async def start(self) -> None:
+        ssl_ctx = None
+        if self.ssl_opts:
+            from emqx_tpu.utils.tls import make_server_context
+            ssl_ctx = make_server_context(self.ssl_opts)
         self._server = await asyncio.start_server(self._on_client,
-                                                  self.bind, self.port)
+                                                  self.bind, self.port,
+                                                  ssl=ssl_ctx)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
 

@@ -26,8 +26,13 @@ class Client:
                  password: Optional[bytes] = None, clean_start: bool = True,
                  keepalive: int = 0, proto_ver: int = C.MQTT_V4,
                  properties: Optional[dict] = None,
-                 will: Optional[P.Will] = None):
+                 will: Optional[P.Will] = None, ssl=None):
         self.host, self.port = host, port
+        # ssl: an ssl.SSLContext, or a dict of emqx-style client tls opts
+        if isinstance(ssl, dict):
+            from emqx_tpu.utils.tls import make_client_context
+            ssl = make_client_context(ssl)
+        self.ssl = ssl
         self.clientid = clientid
         self.username, self.password = username, password
         self.clean_start = clean_start
@@ -55,7 +60,7 @@ class Client:
 
     async def connect(self, timeout: float = 5.0) -> P.Connack:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port)
+            self.host, self.port, ssl=self.ssl)
         pkt = P.Connect(
             proto_name=C.PROTOCOL_NAMES[self.proto_ver],
             proto_ver=self.proto_ver, clean_start=self.clean_start,

@@ -98,7 +98,8 @@ class MqttBridgeWorker:
                                        f"bridge-{self.name}"),
                 username=self.conf.get("username"),
                 password=self.conf.get("password"),
-                clean_start=False)
+                clean_start=False,
+                ssl=self.conf.get("ssl"))  # emqx-style client tls opts dict
             await self.client.connect()
             for sub in self.subscriptions:
                 topic = sub["topic"] if isinstance(sub, dict) else sub

@@ -1,0 +1,208 @@
+"""TLS listeners (mqtts + wss), peer-cert auth, PSK store.
+
+Parity targets: emqx_listeners.erl:126-138 (ssl listener opts),
+emqx_tls_lib.erl (version selection), emqx_schema ssl blocks
+(verify/fail_if_no_peer_cert), emqx_channel peer_cert_as_username,
+emqx_psk.erl (identity store). Certificates are generated per-session
+self-signed chains (the reference ships static test certs in
+apps/emqx/etc/certs)."""
+
+import asyncio
+import ssl
+
+import pytest
+
+from emqx_tpu.broker.connection import Listener
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.utils.psk import PskStore
+from emqx_tpu.utils.tls import (generate_self_signed, make_client_context,
+                                make_server_context)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    return generate_self_signed(str(tmp_path_factory.mktemp("certs")),
+                                cn="localhost", client_cn="client-7")
+
+
+@pytest.fixture()
+def loop():
+    lp = asyncio.new_event_loop()
+    yield lp
+    lp.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 20))
+
+
+class TestMqtts:
+    def test_tls_pubsub_roundtrip(self, loop, certs):
+        node = Node()
+        lst = Listener(node, bind="127.0.0.1", port=0,
+                       ssl_opts={"certfile": certs["certfile"],
+                                 "keyfile": certs["keyfile"]})
+        assert lst.name == "ssl:default"
+
+        async def go():
+            await lst.start()
+            sub = Client(port=lst.port, clientid="tsub",
+                         ssl={"cacertfile": certs["cacertfile"]})
+            pub = Client(port=lst.port, clientid="tpub",
+                         ssl={"cacertfile": certs["cacertfile"]})
+            await sub.connect()
+            await pub.connect()
+            await sub.subscribe("tls/+", qos=1)
+            await pub.publish("tls/x", b"secure", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.topic == "tls/x" and msg.payload == b"secure"
+            await sub.disconnect()
+            await pub.disconnect()
+            await lst.stop()
+        run(loop, go())
+        assert node.metrics.val("client.connected") == 2
+
+    def test_plain_client_rejected_on_tls_port(self, loop, certs):
+        node = Node()
+        lst = Listener(node, bind="127.0.0.1", port=0,
+                       ssl_opts={"certfile": certs["certfile"],
+                                 "keyfile": certs["keyfile"]})
+
+        async def go():
+            await lst.start()
+            c = Client(port=lst.port, clientid="plain")
+            with pytest.raises(Exception):
+                await asyncio.wait_for(c.connect(timeout=3), 5)
+            await lst.stop()
+        run(loop, go())
+
+    def test_client_cert_required(self, loop, certs):
+        node = Node()
+        lst = Listener(node, bind="127.0.0.1", port=0, ssl_opts={
+            "certfile": certs["certfile"], "keyfile": certs["keyfile"],
+            "cacertfile": certs["cacertfile"], "verify": "verify_peer",
+            "fail_if_no_peer_cert": True})
+
+        async def go():
+            await lst.start()
+            # no client cert -> handshake refused
+            bare = Client(port=lst.port, clientid="nocert",
+                          ssl={"cacertfile": certs["cacertfile"]})
+            with pytest.raises(Exception):
+                await asyncio.wait_for(bare.connect(timeout=3), 5)
+            # with client cert -> accepted
+            ok = Client(port=lst.port, clientid="withcert", ssl={
+                "cacertfile": certs["cacertfile"],
+                "certfile": certs["client_certfile"],
+                "keyfile": certs["client_keyfile"]})
+            ack = await ok.connect()
+            assert ack.reason_code == 0
+            await ok.disconnect()
+            await lst.stop()
+        run(loop, go())
+
+    def test_peer_cert_as_username(self, loop, certs):
+        node = Node({"zones": {"certz": {"mqtt": {
+            "peer_cert_as_username": "cn"}}}})
+        lst = Listener(node, bind="127.0.0.1", port=0, zone="certz",
+                       ssl_opts={
+                           "certfile": certs["certfile"],
+                           "keyfile": certs["keyfile"],
+                           "cacertfile": certs["cacertfile"],
+                           "verify": "verify_peer",
+                           "fail_if_no_peer_cert": True})
+
+        async def go():
+            await lst.start()
+            c = Client(port=lst.port, clientid="certclient", ssl={
+                "cacertfile": certs["cacertfile"],
+                "certfile": certs["client_certfile"],
+                "keyfile": certs["client_keyfile"]})
+            await c.connect()
+            chan = node.cm.lookup_channel("certclient")
+            assert chan is not None
+            assert chan.clientinfo["username"] == "client-7"
+            await c.disconnect()
+            await lst.stop()
+        run(loop, go())
+
+    def test_tls12_minimum_enforced(self, certs):
+        ctx = make_server_context({"certfile": certs["certfile"],
+                                   "keyfile": certs["keyfile"],
+                                   "versions": ["tlsv1.2", "tlsv1.3"]})
+        assert ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+        assert ctx.verify_mode == ssl.CERT_NONE
+        ctx13 = make_server_context({"certfile": certs["certfile"],
+                                     "keyfile": certs["keyfile"],
+                                     "versions": ["tlsv1.3"]})
+        assert ctx13.minimum_version == ssl.TLSVersion.TLSv1_3
+
+
+class TestWss:
+    def test_wss_handshake_and_connect(self, loop, certs):
+        from emqx_tpu.broker.ws import OP_BIN, WsListener, accept_key
+        from emqx_tpu.mqtt import packet as P
+        from emqx_tpu.mqtt.frame import FrameParser, serialize
+
+        node = Node()
+        lst = WsListener(node, bind="127.0.0.1", port=0,
+                         ssl_opts={"certfile": certs["certfile"],
+                                   "keyfile": certs["keyfile"]})
+        assert lst.protocol == "mqtt:wss"
+
+        async def go():
+            await lst.start()
+            cctx = make_client_context({"cacertfile": certs["cacertfile"]})
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", lst.port, ssl=cctx)
+            key = "dGhlIHNhbXBsZSBub25jZQ=="
+            req = ("GET /mqtt HTTP/1.1\r\nhost: x\r\n"
+                   "upgrade: websocket\r\nconnection: Upgrade\r\n"
+                   f"sec-websocket-key: {key}\r\n"
+                   "sec-websocket-version: 13\r\n"
+                   "sec-websocket-protocol: mqtt\r\n\r\n")
+            writer.write(req.encode())
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert b"101" in head.split(b"\r\n")[0]
+            assert accept_key(key).encode() in head
+            # CONNECT over a masked binary ws frame
+            connect = serialize(P.Connect(
+                proto_name="MQTT", proto_ver=4, clean_start=True,
+                clientid="wssc"), 4)
+            mask = b"\x11\x22\x33\x44"
+            masked = bytes(c ^ mask[i & 3] for i, c in enumerate(connect))
+            writer.write(bytes([0x80 | OP_BIN, 0x80 | len(connect)])
+                         + mask + masked)
+            await writer.drain()
+            # read CONNACK ws frame (server frames are unmasked)
+            hdr = await reader.readexactly(2)
+            ln = hdr[1] & 0x7F
+            payload = await reader.readexactly(ln)
+            parser = FrameParser()
+            pkts = parser.feed(payload)
+            assert pkts and pkts[0].reason_code == 0
+            writer.close()
+            await lst.stop()
+        run(loop, go())
+
+
+class TestPsk:
+    def test_store_file_and_lookup(self, tmp_path):
+        f = tmp_path / "psk.txt"
+        f.write_text("# comment\nclient1:AABBCC\nclient2:00112233\n\n")
+        store = PskStore()
+        assert store.load_file(str(f)) == 2
+        assert store.lookup("client1") == bytes.fromhex("AABBCC")
+        assert store.lookup("client2") == bytes.fromhex("00112233")
+        assert store.lookup("nope") is None
+        assert store.all() == ["client1", "client2"]
+        assert store.delete("client1") and not store.delete("client1")
+
+    def test_attach_gated_by_runtime(self, certs):
+        store = PskStore()
+        store.insert("id1", "AA")
+        ctx = make_server_context({"certfile": certs["certfile"],
+                                   "keyfile": certs["keyfile"]})
+        assert store.attach(ctx) == store.supported()
