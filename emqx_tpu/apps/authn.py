@@ -235,13 +235,29 @@ class AuthnChain:
     def load(self) -> "AuthnChain":
         self.node.hooks.add("client.authenticate", self.on_authenticate,
                             priority=HP_AUTHN, tag="authn")
+        for a in self.authenticators:
+            self._register_enhanced(a)
         return self
 
     def unload(self) -> None:
         self.node.hooks.delete("client.authenticate", "authn")
+        for a in self.authenticators:
+            if getattr(a, "mechanism", None):
+                getattr(self.node, "enhanced_authn", {}) \
+                    .pop(a.mechanism, None)
+
+    def _register_enhanced(self, a) -> None:
+        """Authenticators with a `mechanism` (SCRAM) also serve the MQTT5
+        AUTH-packet exchange; the channel finds them by method name."""
+        mech = getattr(a, "mechanism", None)
+        if mech:
+            if not hasattr(self.node, "enhanced_authn"):
+                self.node.enhanced_authn = {}
+            self.node.enhanced_authn[mech] = a
 
     def add_authenticator(self, a) -> None:
         self.authenticators.append(a)
+        self._register_enhanced(a)
 
     def remove_authenticator(self, name: str) -> bool:
         n = len(self.authenticators)

@@ -53,6 +53,38 @@ class Client:
         self._suback: dict[int, asyncio.Future] = {}
         self.closed = asyncio.Event()
         self.auto_ack = True
+        self._scram = None
+        self._scram_mech = ""
+        self.scram_server_ok: Optional[bool] = None
+        self._reauth_fut: Optional[asyncio.Future] = None
+
+    def enable_scram(self, username: str, password: str,
+                     algorithm: str = "sha256") -> None:
+        """MQTT5 enhanced authentication: carry SCRAM client-first in
+        CONNECT and answer the broker's AUTH challenge."""
+        from emqx_tpu.utils.scram import ScramClient
+        self._scram = ScramClient(username, password, algorithm)
+        self._scram_mech = "SCRAM-SHA-" + \
+            ("1" if algorithm == "sha1" else algorithm[3:])
+        self.conn_props = dict(self.conn_props or {})
+        self.conn_props["authentication_method"] = self._scram_mech
+        self.conn_props["authentication_data"] = self._scram.first().encode()
+
+    async def reauthenticate(self, username: str, password: str,
+                             algorithm: str = "sha256",
+                             timeout: float = 5.0) -> bool:
+        """AUTH rc=0x19 re-authentication exchange; True on success."""
+        from emqx_tpu.utils.scram import ScramClient
+        self._scram = ScramClient(username, password, algorithm)
+        self._scram_mech = "SCRAM-SHA-" + \
+            ("1" if algorithm == "sha1" else algorithm[3:])
+        self._reauth_fut = asyncio.get_event_loop().create_future()
+        self._send(P.Auth(
+            reason_code=C.RC_RE_AUTHENTICATE,
+            properties={"authentication_method": self._scram_mech,
+                        "authentication_data":
+                            self._scram.first().encode()}))
+        return await asyncio.wait_for(self._reauth_fut, timeout)
 
     def _alloc(self) -> int:
         self._next_pid = (self._next_pid % C.MAX_PACKET_ID) + 1
@@ -102,8 +134,29 @@ class Client:
 
     def _handle(self, pkt: P.Packet) -> None:
         if isinstance(pkt, P.Connack):
+            if self._scram is not None and pkt.reason_code == 0:
+                data = (pkt.properties or {}).get("authentication_data")
+                self.scram_server_ok = bool(data) and \
+                    self._scram.verify_server(bytes(data).decode())
             if not self._connack_fut.done():
                 self._connack_fut.set_result(pkt)
+        elif isinstance(pkt, P.Auth):
+            props = pkt.properties or {}
+            if pkt.reason_code == C.RC_CONTINUE_AUTHENTICATION and \
+                    self._scram is not None:
+                data = bytes(props.get("authentication_data", b""))
+                final = self._scram.final(data.decode())
+                self._send(P.Auth(
+                    reason_code=C.RC_CONTINUE_AUTHENTICATION,
+                    properties={"authentication_method": self._scram_mech,
+                                "authentication_data": final.encode()}))
+            elif pkt.reason_code == 0 and self._reauth_fut is not None:
+                data = props.get("authentication_data")
+                ok = bool(data) and \
+                    self._scram.verify_server(bytes(data).decode())
+                if not self._reauth_fut.done():
+                    self._reauth_fut.set_result(ok)
+                self._reauth_fut = None
         elif isinstance(pkt, P.Publish):
             if pkt.qos == 1 and self.auto_ack:
                 self._send(P.Puback(packet_id=pkt.packet_id))

@@ -1,0 +1,13 @@
+"""Database connectors: asyncio wire-protocol clients.
+
+Parity: apps/emqx_connector — the reference wraps Erlang driver libraries
+(eredis/mysql-otp/epgsql/mongodb/eldap) in ecpool worker pools; no Python
+drivers exist in this environment, so each connector speaks its database's
+wire protocol directly over asyncio streams, pooled by `pool.ConnPool`.
+"""
+
+from emqx_tpu.connectors.pool import ConnPool                # noqa: F401
+from emqx_tpu.connectors.redis import RedisClient, RedisError  # noqa: F401
+from emqx_tpu.connectors.mysql import MysqlClient, MysqlError  # noqa: F401
+from emqx_tpu.connectors.pgsql import PgsqlClient, PgsqlError  # noqa: F401
+from emqx_tpu.connectors.mongo import MongoClient, MongoError  # noqa: F401

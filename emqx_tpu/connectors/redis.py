@@ -1,0 +1,98 @@
+"""Redis connector: RESP2 protocol over asyncio.
+
+Parity: apps/emqx_connector/src/emqx_connector_redis.erl (eredis/ecpool).
+Single-server mode (the reference also offers sentinel/cluster; those ride
+on the same command codec and are out of scope for the broker's authz/rule
+use, which issues simple commands like HGETALL/HMGET).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Union
+
+Arg = Union[str, bytes, int, float]
+
+
+class RedisError(Exception):
+    pass
+
+
+class RedisClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 password: Optional[str] = None,
+                 username: Optional[str] = None,
+                 database: int = 0, ssl=None,
+                 connect_timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.password = password
+        self.username = username
+        self.database = database
+        self.ssl = ssl
+        self.connect_timeout = connect_timeout
+        self._r: Optional[asyncio.StreamReader] = None
+        self._w: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> None:
+        self._r, self._w = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port, ssl=self.ssl),
+            self.connect_timeout)
+        if self.password:
+            if self.username:
+                await self.cmd(["AUTH", self.username, self.password])
+            else:
+                await self.cmd(["AUTH", self.password])
+        if self.database:
+            await self.cmd(["SELECT", str(self.database)])
+
+    async def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+            try:
+                await self._w.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+            self._r = self._w = None
+
+    async def ping(self) -> bool:
+        return await self.cmd(["PING"]) == b"PONG"
+
+    # ---- RESP codec ----
+    @staticmethod
+    def _encode(args: list[Arg]) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        return b"".join(out)
+
+    async def _read_reply(self):
+        line = (await self._r.readuntil(b"\r\n"))[:-2]
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await self._r.readexactly(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [await self._read_reply() for _ in range(n)]
+        raise RedisError(f"bad RESP type byte {kind!r}")
+
+    async def cmd(self, args: list[Arg]):
+        """One command -> decoded reply (bytes / int / list / None)."""
+        if self._w is None:
+            raise ConnectionError("redis client not connected")
+        self._w.write(self._encode(args))
+        await self._w.drain()
+        return await self._read_reply()

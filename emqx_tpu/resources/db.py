@@ -1,0 +1,146 @@
+"""Database resources: pooled connector instances on the Resource behaviour.
+
+Parity: apps/emqx_connector/src/emqx_connector_{redis,mysql,pgsql,mongo}.erl
+— each `on_start`s an ecpool of driver connections, answers `on_query`
+({cmd,...} / {sql,...} / {find,...}) and `on_health_check`. Here the pool
+is connectors.pool.ConnPool over the asyncio wire clients; the query verbs
+keep the reference's shapes so authn/authz/rule-actions code is
+backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from emqx_tpu.connectors import (ConnPool, MongoClient, MysqlClient,
+                                 PgsqlClient, RedisClient)
+from emqx_tpu.resources.resource import Resource, ResourceManager
+
+
+class _PooledDbResource(Resource):
+    """Shared lifecycle: start pool eagerly (status from first connect),
+    health-check = client ping on a pooled connection."""
+
+    def _make_client(self):
+        raise NotImplementedError
+
+    def __init__(self, rid: str, conf: dict):
+        super().__init__(rid, conf)
+        self.pool = ConnPool(self._make_client,
+                             size=int(conf.get("pool_size", 4)))
+
+    async def start(self) -> None:
+        try:
+            await self.pool.start()
+            self.status = "connected"
+        except Exception as e:  # noqa: BLE001
+            self.last_error = str(e)
+            self.status = "disconnected"
+
+    async def stop(self) -> None:
+        await self.pool.stop()
+        self.status = "stopped"
+
+    async def health_check(self) -> bool:
+        try:
+            if not self.pool._started:
+                await self.pool.start()
+            return bool(await self.pool.run(lambda c: c.ping(), timeout=5))
+        except Exception as e:  # noqa: BLE001
+            self.last_error = str(e)
+            return False
+
+
+class RedisResource(_PooledDbResource):
+    TYPE = "redis"
+
+    def _make_client(self) -> RedisClient:
+        c = self.conf
+        return RedisClient(
+            host=c.get("host", "127.0.0.1"), port=c.get("port", 6379),
+            username=c.get("username"), password=c.get("password"),
+            database=int(c.get("database", 0)), ssl=c.get("ssl"))
+
+    async def query(self, request: Any) -> Any:
+        """request: list command ["HGETALL", key] (the {cmd, CMD} verb)."""
+        return await self.pool.run(lambda c: c.cmd(list(request)),
+                                   timeout=self.conf.get("timeout", 5))
+
+
+class MysqlResource(_PooledDbResource):
+    TYPE = "mysql"
+
+    def _make_client(self) -> MysqlClient:
+        c = self.conf
+        return MysqlClient(
+            host=c.get("host", "127.0.0.1"), port=c.get("port", 3306),
+            username=c.get("username", "root"),
+            password=c.get("password", ""),
+            database=c.get("database"), ssl=c.get("ssl"))
+
+    async def query(self, request: Any) -> Any:
+        """request: ("sql", query, params) or plain SQL string
+        -> (columns, rows)."""
+        sql, params = _sql_request(request)
+        return await self.pool.run(lambda c: c.query(sql, params),
+                                   timeout=self.conf.get("timeout", 5))
+
+
+class PgsqlResource(_PooledDbResource):
+    TYPE = "pgsql"
+
+    def _make_client(self) -> PgsqlClient:
+        c = self.conf
+        return PgsqlClient(
+            host=c.get("host", "127.0.0.1"), port=c.get("port", 5432),
+            username=c.get("username", "postgres"),
+            password=c.get("password", ""),
+            database=c.get("database", "postgres"), ssl=c.get("ssl"))
+
+    async def query(self, request: Any) -> Any:
+        sql, params = _sql_request(request)
+        return await self.pool.run(lambda c: c.query(sql, params),
+                                   timeout=self.conf.get("timeout", 5))
+
+
+class MongoResource(_PooledDbResource):
+    TYPE = "mongo"
+
+    def _make_client(self) -> MongoClient:
+        c = self.conf
+        return MongoClient(
+            host=c.get("host", "127.0.0.1"), port=c.get("port", 27017),
+            username=c.get("username"), password=c.get("password", ""),
+            database=c.get("database", "mqtt"),
+            auth_source=c.get("auth_source", "admin"),
+            auth_algo=c.get("auth_algo", "sha256"), ssl=c.get("ssl"))
+
+    async def query(self, request: Any) -> Any:
+        """request: ("find", collection, filter) -> list of docs,
+        ("insert", collection, docs) -> count, or a raw command dict."""
+        timeout = self.conf.get("timeout", 5)
+        if isinstance(request, dict):
+            return await self.pool.run(lambda c: c.command(request),
+                                       timeout=timeout)
+        verb = request[0]
+        if verb == "find":
+            return await self.pool.run(
+                lambda c: c.find(request[1], request[2]), timeout=timeout)
+        if verb == "insert":
+            return await self.pool.run(
+                lambda c: c.insert(request[1], list(request[2])),
+                timeout=timeout)
+        raise ValueError(f"unknown mongo verb {verb!r}")
+
+
+def _sql_request(request: Any) -> tuple[str, Optional[list]]:
+    if isinstance(request, str):
+        return request, None
+    if isinstance(request, (tuple, list)) and request and \
+            request[0] == "sql":
+        return request[1], list(request[2]) if len(request) > 2 else None
+    raise ValueError(f"bad sql request {request!r}")
+
+
+for _cls in (RedisResource, MysqlResource, PgsqlResource, MongoResource):
+    ResourceManager.register_type(_cls.TYPE, _cls)

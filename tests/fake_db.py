@@ -1,0 +1,435 @@
+"""In-process fake database servers speaking just enough wire protocol.
+
+The repo's test pattern (like the fake HTTP/exhook servers): boot a real
+asyncio server on an ephemeral port and drive the production connector
+clients against it — mirrors the reference's meck-per-driver approach but
+exercises the actual codec bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+from typing import Callable, Optional
+
+from emqx_tpu.utils import bson
+from emqx_tpu.utils.scram import ScramServer, make_credentials
+
+
+class _FakeServer:
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+
+    async def start(self) -> "_FakeServer":
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            for w in list(self._writers):
+                w.close()
+            try:
+                # py3.12 wait_closed blocks until every handler returns
+                await asyncio.wait_for(self._server.wait_closed(), 2)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _on_client(self, reader, writer):
+        self._writers.add(writer)
+        try:
+            await self.session(reader, writer)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def session(self, reader, writer):  # pragma: no cover
+        raise NotImplementedError
+
+
+class FakeRedis(_FakeServer):
+    """RESP2 server: AUTH/SELECT/PING + hash commands over a dict store."""
+
+    def __init__(self, password: Optional[str] = None):
+        super().__init__()
+        self.password = password
+        self.hashes: dict[str, dict[str, str]] = {}
+        self.commands: list[list[bytes]] = []
+
+    async def _read_cmd(self, reader) -> Optional[list[bytes]]:
+        line = (await reader.readuntil(b"\r\n"))[:-2]
+        if not line.startswith(b"*"):
+            return None
+        n = int(line[1:])
+        args = []
+        for _ in range(n):
+            head = (await reader.readuntil(b"\r\n"))[:-2]
+            size = int(head[1:])
+            data = await reader.readexactly(size + 2)
+            args.append(data[:-2])
+        return args
+
+    @staticmethod
+    def _bulk(v: Optional[str]) -> bytes:
+        if v is None:
+            return b"$-1\r\n"
+        b = v.encode() if isinstance(v, str) else v
+        return b"$%d\r\n%s\r\n" % (len(b), b)
+
+    async def session(self, reader, writer):
+        authed = self.password is None
+        while True:
+            args = await self._read_cmd(reader)
+            if args is None:
+                return
+            self.commands.append(args)
+            cmd = args[0].upper()
+            if cmd == b"AUTH":
+                if args[-1].decode() == (self.password or ""):
+                    authed = True
+                    writer.write(b"+OK\r\n")
+                else:
+                    writer.write(b"-ERR invalid password\r\n")
+            elif not authed:
+                writer.write(b"-NOAUTH Authentication required.\r\n")
+            elif cmd == b"SELECT":
+                writer.write(b"+OK\r\n")
+            elif cmd == b"PING":
+                writer.write(b"+PONG\r\n")
+            elif cmd == b"HGETALL":
+                h = self.hashes.get(args[1].decode(), {})
+                out = [b"*%d\r\n" % (len(h) * 2)]
+                for k, v in h.items():
+                    out.append(self._bulk(k))
+                    out.append(self._bulk(v))
+                writer.write(b"".join(out))
+            elif cmd == b"HMGET":
+                h = self.hashes.get(args[1].decode(), {})
+                fields = [a.decode() for a in args[2:]]
+                out = [b"*%d\r\n" % len(fields)]
+                for f in fields:
+                    out.append(self._bulk(h.get(f)))
+                writer.write(b"".join(out))
+            elif cmd == b"GET":
+                writer.write(self._bulk(None))
+            else:
+                writer.write(b"-ERR unknown command\r\n")
+            await writer.drain()
+
+
+def _mysql_scramble(password: bytes, nonce: bytes) -> bytes:
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(nonce + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+class FakeMysql(_FakeServer):
+    """Protocol-v10 server: native-password handshake + COM_QUERY routed
+    to `handler(sql) -> (columns, rows) | None` (None -> OK packet)."""
+
+    def __init__(self, username: str = "root", password: str = "",
+                 handler: Optional[Callable] = None):
+        super().__init__()
+        self.username = username
+        self.password = password
+        self.handler = handler or (lambda sql: ([], []))
+        self.queries: list[str] = []
+
+    @staticmethod
+    def _lenenc_str(b: bytes) -> bytes:
+        return bytes([len(b)]) + b
+
+    async def session(self, reader, writer):
+        seq = 0
+
+        def send(payload: bytes) -> None:
+            nonlocal seq
+            writer.write(len(payload).to_bytes(3, "little")
+                         + bytes([seq & 0xFF]) + payload)
+            seq += 1
+
+        async def recv() -> bytes:
+            nonlocal seq
+            head = await reader.readexactly(4)
+            seq = head[3] + 1
+            return await reader.readexactly(
+                int.from_bytes(head[:3], "little"))
+
+        nonce = b"abcdefgh12345678mnop"       # 20 bytes
+        greet = (b"\x0a" + b"8.0.0-fake\x00"
+                 + struct.pack("<I", 1)                       # thread id
+                 + nonce[:8] + b"\x00"
+                 + struct.pack("<H", 0xFFFF)                  # caps lo
+                 + b"\x21" + struct.pack("<H", 2)             # charset,status
+                 + struct.pack("<H", 0x000F)                  # caps hi
+                 + bytes([21]) + b"\x00" * 10
+                 + nonce[8:] + b"\x00"
+                 + b"mysql_native_password\x00")
+        send(greet)
+        await writer.drain()
+        resp = await recv()
+        # parse: caps(4) maxpkt(4) charset(1) 23 zeros, user\0, authlen+auth
+        pos = 32
+        end = resp.index(b"\x00", pos)
+        user = resp[pos:end].decode()
+        pos = end + 1
+        alen = resp[pos]
+        auth = resp[pos + 1:pos + 1 + alen]
+        expect = _mysql_scramble(self.password.encode(), nonce)
+        if user != self.username or auth != expect:
+            msg = b"Access denied"
+            send(b"\xff" + struct.pack("<H", 1045) + b"#28000" + msg)
+            await writer.drain()
+            return
+        send(b"\x00\x00\x00\x02\x00\x00\x00")                 # OK
+        await writer.drain()
+
+        while True:
+            seq = 0
+            pkt = await recv()
+            com = pkt[:1]
+            if com == b"\x01":                                # COM_QUIT
+                return
+            if com == b"\x0e":                                # COM_PING
+                send(b"\x00\x00\x00\x02\x00\x00\x00")
+                await writer.drain()
+                continue
+            if com != b"\x03":
+                send(b"\xff" + struct.pack("<H", 1047)
+                     + b"#08S01" + b"unknown command")
+                await writer.drain()
+                continue
+            sql = pkt[1:].decode()
+            self.queries.append(sql)
+            result = self.handler(sql)
+            if result is None:
+                send(b"\x00\x00\x00\x02\x00\x00\x00")
+                await writer.drain()
+                continue
+            columns, rows = result
+            send(bytes([len(columns)]))
+            for name in columns:
+                nb = name.encode()
+                cdef = (self._lenenc_str(b"def") + self._lenenc_str(b"db")
+                        + self._lenenc_str(b"t") + self._lenenc_str(b"t")
+                        + self._lenenc_str(nb) + self._lenenc_str(nb)
+                        + b"\x0c" + struct.pack("<H", 0x21)
+                        + struct.pack("<I", 255) + b"\xfd"
+                        + struct.pack("<H", 0) + b"\x00" + b"\x00\x00")
+                send(cdef)
+            send(b"\xfe\x00\x00\x02\x00")                     # EOF
+            for row in rows:
+                out = b""
+                for v in row:
+                    if v is None:
+                        out += b"\xfb"
+                    else:
+                        vb = str(v).encode()
+                        out += self._lenenc_str(vb) if len(vb) < 251 \
+                            else b"\xfc" + struct.pack("<H", len(vb)) + vb
+                send(out)
+            send(b"\xfe\x00\x00\x02\x00")                     # EOF
+            await writer.drain()
+
+
+class FakePgsql(_FakeServer):
+    """Protocol-v3 server: configurable auth (trust/cleartext/md5/scram) +
+    simple Query routed to `handler(sql) -> (columns, rows)`."""
+
+    def __init__(self, username: str = "postgres", password: str = "",
+                 auth: str = "scram", handler: Optional[Callable] = None):
+        super().__init__()
+        self.username = username
+        self.password = password
+        self.auth = auth
+        self.handler = handler or (lambda sql: ([], []))
+        self.queries: list[str] = []
+
+    async def session(self, reader, writer):
+        head = await reader.readexactly(4)
+        n = struct.unpack(">i", head)[0]
+        body = await reader.readexactly(n - 4)
+        proto = struct.unpack(">i", body[:4])[0]
+        assert proto == 196608, f"unexpected protocol {proto}"
+
+        def send(mtype: bytes, payload: bytes) -> None:
+            writer.write(mtype + struct.pack(">i", len(payload) + 4)
+                         + payload)
+
+        async def recv() -> tuple[bytes, bytes]:
+            h = await reader.readexactly(5)
+            ln = struct.unpack(">i", h[1:])[0]
+            return h[:1], await reader.readexactly(ln - 4)
+
+        ok = False
+        if self.auth == "trust":
+            ok = True
+        elif self.auth == "cleartext":
+            send(b"R", struct.pack(">i", 3))
+            await writer.drain()
+            _, b = await recv()
+            ok = b.rstrip(b"\x00").decode() == self.password
+        elif self.auth == "md5":
+            salt = b"SALT"
+            send(b"R", struct.pack(">i", 5) + salt)
+            await writer.drain()
+            _, b = await recv()
+            inner = hashlib.md5(self.password.encode()
+                                + self.username.encode()).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            ok = b.rstrip(b"\x00").decode() == want
+        else:                                                # scram
+            send(b"R", struct.pack(">i", 10) + b"SCRAM-SHA-256\x00\x00")
+            await writer.drain()
+            _, b = await recv()
+            end = b.index(b"\x00")
+            assert b[:end] == b"SCRAM-SHA-256"
+            flen = struct.unpack(">i", b[end + 1:end + 5])[0]
+            client_first = b[end + 5:end + 5 + flen].decode()
+            cred = make_credentials(self.password, "sha256")
+            srv = ScramServer(lambda u: cred, "sha256")
+            try:
+                sf = srv.challenge(client_first)
+                send(b"R", struct.pack(">i", 11) + sf.encode())
+                await writer.drain()
+                _, b = await recv()
+                final = srv.finish(b.decode())
+                send(b"R", struct.pack(">i", 12) + final.encode())
+                ok = True
+            except Exception:  # noqa: BLE001
+                ok = False
+        if not ok:
+            send(b"E", b"SFATAL\x00C28P01\x00"
+                 b"Mpassword authentication failed\x00\x00")
+            await writer.drain()
+            return
+        send(b"R", struct.pack(">i", 0))                     # AuthOk
+        send(b"S", b"server_version\x0014.0-fake\x00")
+        send(b"K", struct.pack(">ii", 1, 2))
+        send(b"Z", b"I")
+        await writer.drain()
+
+        while True:
+            mtype, body = await recv()
+            if mtype == b"X":
+                return
+            if mtype != b"Q":
+                continue
+            sql = body.rstrip(b"\x00").decode()
+            self.queries.append(sql)
+            try:
+                columns, rows = self.handler(sql)
+            except Exception as e:  # noqa: BLE001
+                send(b"E", b"SERROR\x00C42601\x00M"
+                     + str(e).encode() + b"\x00\x00")
+                send(b"Z", b"I")
+                await writer.drain()
+                continue
+            if columns:
+                desc = struct.pack(">h", len(columns))
+                for c in columns:
+                    desc += (c.encode() + b"\x00"
+                             + struct.pack(">ihihih", 0, 0, 25, -1, -1, 0))
+                send(b"T", desc)
+            for row in rows:
+                out = struct.pack(">h", len(row))
+                for v in row:
+                    if v is None:
+                        out += struct.pack(">i", -1)
+                    else:
+                        vb = str(v).encode()
+                        out += struct.pack(">i", len(vb)) + vb
+                send(b"D", out)
+            send(b"C", b"SELECT %d\x00" % len(rows))
+            send(b"Z", b"I")
+            await writer.drain()
+
+
+class FakeMongo(_FakeServer):
+    """OP_MSG server: ping/find/insert over an in-memory collection map +
+    SCRAM saslStart/saslContinue when credentials are configured."""
+
+    def __init__(self, username: Optional[str] = None,
+                 password: str = "", algo: str = "sha256"):
+        super().__init__()
+        self.username = username
+        self.password = password
+        self.algo = algo
+        self.collections: dict[str, list[dict]] = {}
+        self.commands: list[dict] = []
+
+    async def session(self, reader, writer):
+        authed = self.username is None
+        scram: Optional[ScramServer] = None
+        while True:
+            head = await reader.readexactly(16)
+            total, req_id, _, opcode = struct.unpack("<iiii", head)
+            data = await reader.readexactly(total - 16)
+            assert opcode == 2013
+            doc = bson.decode(data[5:])
+            self.commands.append(doc)
+            reply = self._dispatch(doc, authed)
+            if "___scram" in reply:
+                phase = reply.pop("___scram")
+                try:
+                    if phase == "start":
+                        cred = make_credentials(self.password, self.algo)
+                        cred_for = {self.username: cred}
+                        scram = ScramServer(cred_for.get, self.algo)
+                        challenge = scram.challenge(
+                            bytes(doc["payload"]).decode())
+                        reply.update({"ok": 1.0, "conversationId": 1,
+                                      "done": False,
+                                      "payload": challenge.encode()})
+                    else:
+                        final = scram.finish(bytes(doc["payload"]).decode())
+                        authed = True
+                        reply.update({"ok": 1.0, "conversationId": 1,
+                                      "done": True,
+                                      "payload": final.encode()})
+                except Exception:  # noqa: BLE001
+                    reply.update({"ok": 0.0, "code": 18,
+                                  "errmsg": "Authentication failed."})
+            body = bson.encode(reply)
+            payload = struct.pack("<i", 0) + b"\x00" + body
+            writer.write(struct.pack("<iiii", len(payload) + 16,
+                                     1000 + req_id, req_id, 2013) + payload)
+            await writer.drain()
+
+    def _dispatch(self, doc: dict, authed: bool) -> dict:
+        if "saslStart" in doc:
+            return {"___scram": "start"}
+        if "saslContinue" in doc:
+            return {"___scram": "continue"}
+        if not authed:
+            return {"ok": 0.0, "code": 13,
+                    "errmsg": "command requires authentication"}
+        if "ping" in doc:
+            return {"ok": 1.0}
+        if "find" in doc:
+            coll = self.collections.get(doc["find"], [])
+            filt = doc.get("filter", {})
+            rows = [d for d in coll
+                    if all(d.get(k) == v for k, v in filt.items())]
+            limit = doc.get("limit", 0)
+            if limit:
+                rows = rows[:limit]
+            return {"ok": 1.0, "cursor": {
+                "id": 0, "ns": f"db.{doc['find']}", "firstBatch": rows}}
+        if "insert" in doc:
+            self.collections.setdefault(doc["insert"], []).extend(
+                doc.get("documents", []))
+            return {"ok": 1.0, "n": len(doc.get("documents", []))}
+        return {"ok": 0.0, "code": 59, "errmsg": "no such command"}
