@@ -261,8 +261,13 @@ class AuthnChain:
 
     def remove_authenticator(self, name: str) -> bool:
         n = len(self.authenticators)
+        removed = [a for a in self.authenticators if a.name == name]
         self.authenticators = [a for a in self.authenticators
                                if a.name != name]
+        for a in removed:       # also stop serving its AUTH exchanges
+            if getattr(a, "mechanism", None):
+                getattr(self.node, "enhanced_authn", {}) \
+                    .pop(a.mechanism, None)
         return len(self.authenticators) < n
 
     async def on_authenticate(self, clientinfo: dict, acc):

@@ -107,12 +107,14 @@ class RedisSource:
     async def authorize_async(self, clientinfo: dict, action: str,
                               topic: str) -> str:
         peer = clientinfo.get("peername")
-        cmd = (self.cmd
-               .replace("%u", clientinfo.get("username") or "")
-               .replace("%c", clientinfo.get("clientid") or "")
-               .replace("%a", str(peer[0]) if peer else ""))
+        # split FIRST, substitute per token: a username containing spaces
+        # must not change the command arity (argument injection)
+        args = [t.replace("%u", clientinfo.get("username") or "")
+                 .replace("%c", clientinfo.get("clientid") or "")
+                 .replace("%a", str(peer[0]) if peer else "")
+                for t in self.cmd.split(" ")]
         try:
-            reply = await self.resource.query(cmd.split(" "))
+            reply = await self.resource.query(args)
         except Exception:  # noqa: BLE001
             return NOMATCH
         if not reply:

@@ -98,9 +98,113 @@ def deep_merge(base: dict, over: dict) -> dict:
     return out
 
 
+def check_schema(conf: dict, schema: Optional[dict] = None,
+                 path: str = "") -> list[str]:
+    """Type-check a loaded config against the DEFAULTS tree
+    (the hocon_schema:check_plain analog). Duration strings ("30s") and
+    size strings ("1MB") are coerced in place where the schema default is
+    numeric; unknown keys are allowed (feature apps read their own
+    sections). Returns a list of error strings."""
+    from emqx_tpu.utils.hocon import parse_duration, parse_size
+    schema = DEFAULTS if schema is None else schema
+    errors: list[str] = []
+    for key, val in list(conf.items()):
+        here = f"{path}.{key}" if path else key
+        if key not in schema:
+            continue
+        want = schema[key]
+        if isinstance(want, dict) and path not in ("zones", "listeners"):
+            if not isinstance(val, dict):
+                errors.append(f"{here}: expected object, got "
+                              f"{type(val).__name__}")
+            elif here not in ("zones", "listeners", "mqueue_priorities"):
+                errors.extend(check_schema(val, want, here))
+            continue
+        if isinstance(want, bool):
+            if not isinstance(val, bool):
+                errors.append(f"{here}: expected bool, got {val!r}")
+            continue
+        if isinstance(want, (int, float)) and not isinstance(want, bool):
+            if isinstance(val, str):
+                coerced = parse_duration(val)
+                if coerced is None:
+                    coerced = parse_size(val)
+                if coerced is None:
+                    errors.append(f"{here}: expected number, got {val!r}")
+                else:
+                    conf[key] = type(want)(coerced) \
+                        if isinstance(want, int) and \
+                        float(coerced).is_integer() else coerced
+            elif isinstance(val, bool) or \
+                    not isinstance(val, (int, float)):
+                errors.append(f"{here}: expected number, got {val!r}")
+            continue
+        if isinstance(want, str) and val is not None and \
+                not isinstance(val, str):
+            errors.append(f"{here}: expected string, got {val!r}")
+        if isinstance(want, list) and not isinstance(val, list):
+            errors.append(f"{here}: expected array, got {val!r}")
+    return errors
+
+
 class Config:
-    def __init__(self, overrides: Optional[dict] = None):
+    def __init__(self, overrides: Optional[dict] = None,
+                 override_file: Optional[str] = None):
         self._c = deep_merge(copy.deepcopy(DEFAULTS), overrides or {})
+        self.override_file = override_file
+        self._overrides: dict = {}
+        self._handlers: list[tuple[tuple, Any]] = []
+
+    @classmethod
+    def load_file(cls, path: str,
+                  override_file: Optional[str] = None) -> "Config":
+        """Boot from an etc/emqx.conf-style HOCON file, applying the
+        persisted runtime-override file on top (emqx_config:init_load).
+        Raises ValueError on schema type errors."""
+        import os
+
+        from emqx_tpu.utils import hocon
+        conf = hocon.load(path)
+        if override_file is None:
+            override_file = os.path.join(
+                os.path.dirname(path) or ".", "emqx_override.conf")
+        persisted: dict = {}
+        if os.path.exists(override_file):
+            persisted = hocon.load(override_file)
+            conf = deep_merge(conf, persisted)
+        errors = check_schema(conf)
+        if errors:
+            raise ValueError("config schema errors: " + "; ".join(errors))
+        out = cls(conf, override_file=override_file)
+        # seed with what is already on disk so the next update() rewrite
+        # does not discard overrides persisted by previous runs
+        out._overrides = persisted
+        return out
+
+    # ---- runtime updates (emqx_config_handler) ----
+    def register_handler(self, path: "tuple | list", handler) -> None:
+        """handler(path, new_value, config) called before the update is
+        applied for any update at or under `path`; raising vetoes it."""
+        self._handlers.append((tuple(path), handler))
+
+    def update(self, path: "tuple | list", value: Any,
+               persist: bool = True) -> None:
+        """Apply a runtime config update through registered handlers and
+        persist it to the override file (emqx_config_handler:update +
+        save_to_override_conf)."""
+        path = tuple(path)
+        for prefix, handler in self._handlers:
+            if path[:len(prefix)] == prefix or prefix[:len(path)] == path:
+                handler(path, value, self)
+        self.put(path, value)
+        cur = self._overrides
+        for p in path[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[path[-1]] = value
+        if persist and self.override_file:
+            from emqx_tpu.utils import hocon
+            with open(self.override_file, "w", encoding="utf-8") as f:
+                f.write(hocon.dumps(self._overrides))
 
     def get(self, *path, default: Any = None) -> Any:
         """get('mqtt') or get('mqtt', 'max_inflight')."""

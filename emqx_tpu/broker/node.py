@@ -78,6 +78,51 @@ class Node:
         self._apps: list = []      # started feature apps (retainer, ...)
         self._timer_task: Optional[asyncio.Task] = None
 
+    # ---- config-file boot (emqx_machine_app load_config_files +
+    #      emqx_listeners:start) ----
+    @classmethod
+    def from_config_file(cls, path: str, **kw) -> "Node":
+        from emqx_tpu.broker.config import Config
+        return cls(Config.load_file(path), **kw)
+
+    async def start_listeners(self) -> list:
+        """Start every listener configured under `listeners`
+        (emqx_listeners.erl:91,126-138: tcp/ssl esockd, ws/wss cowboy)."""
+        from emqx_tpu.broker.connection import Listener
+        from emqx_tpu.broker.ws import WsListener
+        for name, lc in (self.config.get("listeners") or {}).items():
+            if not lc.get("enabled", True):
+                continue
+            ltype = lc.get("type", "tcp")
+            ssl_opts = lc.get("ssl") \
+                if ltype in ("ssl", "wss") or "ssl" in lc else None
+            if ltype in ("ssl", "wss") and not ssl_opts:
+                # never silently downgrade a TLS listener to plaintext
+                raise ValueError(
+                    f"listener {name!r} is type {ltype} but has no ssl "
+                    f"block")
+            common = dict(bind=lc.get("bind", "0.0.0.0"),
+                          port=int(lc.get("port", 0)),
+                          zone=lc.get("zone"),
+                          max_connections=int(
+                              lc.get("max_connections", 1024000)),
+                          ssl_opts=ssl_opts)
+            if ltype in ("ws", "wss"):
+                lst = WsListener(self, path=lc.get("path", "/mqtt"),
+                                 **common)
+            elif ltype in ("tcp", "ssl"):
+                lst = Listener(self, name=f"{ltype}:{name}", **common)
+            else:
+                raise ValueError(f"unknown listener type {ltype!r}")
+            await lst.start()
+            self.listeners.append(lst)
+        return self.listeners
+
+    async def stop_listeners(self) -> None:
+        for lst in self.listeners:
+            await lst.stop()
+        self.listeners.clear()
+
     # ---- periodic housekeeping (the reference's per-subsystem timers:
     #      session expiry, retained expiry scan, delayed fire, stats) ----
     def sweep(self) -> None:

@@ -118,7 +118,26 @@ class MongoClient:
         if limit:
             cmd["limit"] = limit
         reply = await self.command(cmd)
-        return list(reply.get("cursor", {}).get("firstBatch", []))
+        cursor = reply.get("cursor", {})
+        out = list(cursor.get("firstBatch", []))
+        # drain the cursor: firstBatch caps at the server default (~101
+        # docs); results past it need getMore until cursor id 0
+        cid = cursor.get("id", 0)
+        while cid:
+            reply = await self.command({"getMore": cid,
+                                        "collection": collection})
+            cursor = reply.get("cursor", {})
+            out.extend(cursor.get("nextBatch", []))
+            cid = cursor.get("id", 0)
+            if limit and len(out) >= limit:
+                if cid:
+                    try:
+                        await self.command({"killCursors": collection,
+                                            "cursors": [cid]})
+                    except MongoError:
+                        pass
+                return out[:limit]
+        return out
 
     async def find_one(self, collection: str,
                        filter_doc: dict) -> Optional[dict]:

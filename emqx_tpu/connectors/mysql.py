@@ -162,7 +162,9 @@ class MysqlClient:
             plugin = reply[1:end].decode()
             if plugin != "mysql_native_password":
                 raise MysqlError(0, f"unsupported auth plugin {plugin}")
-            new_nonce = reply[end + 1:].rstrip(b"\x00")
+            new_nonce = reply[end + 1:]
+            if new_nonce.endswith(b"\x00"):   # strip ONLY the terminator —
+                new_nonce = new_nonce[:-1]    # scramble bytes may be 0x00
             self._write_packet(
                 _native_scramble(self.password.encode(), new_nonce))
             reply = await self._read_packet()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import re
 import struct
 from typing import Any, Optional
 
@@ -40,11 +41,20 @@ def escape(value: Any) -> str:
     return f"'{s}'"
 
 
+_PARAM_RE = re.compile(r"\$(\d+)")
+
+
 def bind_params(query: str, params: list) -> str:
-    # replace $n descending so $10 is not clobbered by $1
-    for i in range(len(params), 0, -1):
-        query = query.replace(f"${i}", escape(params[i - 1]))
-    return query
+    # single-pass substitution: a parameter VALUE containing "$1" must
+    # never be re-substituted (injection via client-controlled strings)
+    def _sub(m: re.Match) -> str:
+        idx = int(m.group(1))
+        if not 1 <= idx <= len(params):
+            raise ValueError(f"query references ${idx} but only "
+                             f"{len(params)} params given")
+        return escape(params[idx - 1])
+
+    return _PARAM_RE.sub(_sub, query)
 
 
 class PgsqlClient:

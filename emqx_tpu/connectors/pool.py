@@ -52,10 +52,18 @@ class ConnPool:
             self._free.get_nowait()
 
     async def _acquire(self):
+        if not self._started:
+            raise ConnectionError("pool not started")
         client = await self._free.get()
         if client is None:
             client = self._factory()
-            await client.connect()
+            try:
+                await client.connect()
+            except BaseException:
+                # ANY connect failure (auth rejection included) must give
+                # the slot token back or the pool shrinks to a deadlock
+                self._free.put_nowait(None)
+                raise
             self._clients.append(client)
         return client
 
@@ -68,11 +76,7 @@ class ConnPool:
     async def run(self, op: Callable[[object], Awaitable],
                   timeout: Optional[float] = None):
         """Run op(client) on a pooled connection."""
-        try:
-            client = await self._acquire()
-        except _IO_ERRORS:
-            self._free.put_nowait(None)
-            raise
+        client = await self._acquire()   # restores its slot on failure
         try:
             result = await asyncio.wait_for(op(client), timeout)
         except _IO_ERRORS:

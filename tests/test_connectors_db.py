@@ -125,6 +125,40 @@ class TestRedis:
             await srv.stop()
         run(loop, go())
 
+    def test_pool_survives_connect_rejection(self, loop):
+        # a non-IO connect failure (auth rejection) must not leak the
+        # pool slot: after `size` failures the pool still serves
+        async def go():
+            srv = await FakeRedis(password="right").start()
+            pw = ["bad"]                    # first connect rejected
+
+            def factory():
+                p = pw.pop(0) if pw else "right"
+                return RedisClient(port=srv.port, password=p)
+            pool = ConnPool(factory, size=2)
+            with pytest.raises(RedisError):
+                await pool.start()
+            await pool.start()              # retry boots the pool
+            # make the ONE lazy slot connect-fail with an auth rejection
+            pw.append("bad")
+
+            async def hold(c):              # pin the good connection so
+                await asyncio.sleep(0.05)   # the next run takes the lazy
+                return await c.ping()       # slot
+            t1 = asyncio.ensure_future(pool.run(hold))
+            await asyncio.sleep(0.01)
+            with pytest.raises(RedisError):
+                await asyncio.wait_for(pool.run(lambda c: c.ping()), 2)
+            assert await t1
+            # both slots must still serve after the rejection (no leak)
+            r = await asyncio.gather(
+                *[asyncio.wait_for(pool.run(lambda c: c.ping()), 2)
+                  for _ in range(4)])
+            assert all(r)
+            await pool.stop()
+            await srv.stop()
+        run(loop, go())
+
     def test_pool_reconnects(self, loop):
         async def go():
             srv = await FakeRedis().start()
@@ -200,6 +234,15 @@ class TestPgsql:
             await c.close()
             await srv.stop()
         run(loop, go())
+
+    def test_bind_params_no_resubstitution(self):
+        from emqx_tpu.connectors.pgsql import bind_params
+        out = bind_params("SELECT h FROM u WHERE n = $1 AND p = $2",
+                          ["alice", "pw with $1 inside"])
+        assert out == ("SELECT h FROM u WHERE n = 'alice' "
+                       "AND p = 'pw with $1 inside'")
+        with pytest.raises(ValueError):
+            bind_params("SELECT $3", ["a"])
 
     def test_bad_password_and_error(self, loop):
         async def go():

@@ -1,0 +1,218 @@
+"""Tests: HOCON-lite loader, schema check, config-file boot, runtime
+updates with override persistence.
+
+Mirrors the reference's emqx_config/emqx_config_handler behavior
+(apps/emqx/src/emqx_config.erl, emqx_config_handler.erl) and the hocon
+syntax its etc/emqx.conf files rely on.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.config import Config, check_schema
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.utils import hocon
+
+
+class TestHoconParse:
+    def test_basics(self):
+        conf = hocon.loads("""
+        # comment
+        broker {
+          sys_msg_interval = 30        // trailing comment
+          shared_subscription_strategy = random
+        }
+        mqtt.max_inflight = 64
+        mqtt.retain_available = false
+        listeners.default { type: tcp, port: 1883 }
+        tags = [a, "b c", 3]
+        nothing = null
+        """)
+        assert conf["broker"]["sys_msg_interval"] == 30
+        assert conf["broker"]["shared_subscription_strategy"] == "random"
+        assert conf["mqtt"] == {"max_inflight": 64,
+                                "retain_available": False}
+        assert conf["listeners"]["default"] == {"type": "tcp",
+                                                "port": 1883}
+        assert conf["tags"] == ["a", "b c", 3]
+        assert conf["nothing"] is None
+
+    def test_merge_and_append(self):
+        conf = hocon.loads("""
+        a { x = 1 }
+        a { y = 2 }
+        a.x = 3
+        arr = [1]
+        arr += 2
+        fresh += "first"
+        """)
+        assert conf["a"] == {"x": 3, "y": 2}
+        assert conf["arr"] == [1, 2]
+        assert conf["fresh"] == ["first"]
+
+    def test_substitution(self, monkeypatch):
+        monkeypatch.setenv("EMQX_TEST_PORT", "2883")
+        conf = hocon.loads("""
+        base { port = 1883 }
+        l1.port = ${base.port}
+        l2.port = ${?EMQX_TEST_PORT}
+        l3 { port = ${?MISSING_THING} }
+        """)
+        assert conf["l1"]["port"] == 1883
+        assert conf["l2"]["port"] == 2883
+        assert "port" not in conf["l3"]
+
+    def test_missing_substitution_raises(self):
+        with pytest.raises(hocon.HoconError):
+            hocon.loads("x = ${no.such.path}")
+
+    def test_include(self, tmp_path):
+        (tmp_path / "base.conf").write_text('mqtt { max_inflight = 7 }\n')
+        (tmp_path / "main.conf").write_text(
+            'include "base.conf"\nmqtt.idle_timeout = 30\n')
+        conf = hocon.load(str(tmp_path / "main.conf"))
+        assert conf["mqtt"] == {"max_inflight": 7, "idle_timeout": 30}
+
+    def test_strings_and_escapes(self):
+        conf = hocon.loads(r'''
+        a = "line\nbreak"
+        b = """raw "quoted" text"""
+        "key with space" = ok
+        ''')
+        assert conf["a"] == "line\nbreak"
+        assert conf["b"] == 'raw "quoted" text'
+        assert conf["key with space"] == "ok"
+
+    def test_dumps_roundtrip(self):
+        orig = {"broker": {"sys_msg_interval": 30, "flag": True},
+                "tags": ["x", "y z"], "name": "emqx@127.0.0.1"}
+        assert hocon.loads(hocon.dumps(orig)) == orig
+
+    def test_durations_sizes(self):
+        assert hocon.parse_duration("30s") == 30
+        assert hocon.parse_duration("100ms") == 0.1
+        assert hocon.parse_duration("2h") == 7200
+        assert hocon.parse_duration("plain") is None
+        assert hocon.parse_size("16KB") == 16384
+        assert hocon.parse_size("1MB") == 1048576
+
+
+class TestSchemaCheck:
+    def test_coercion(self):
+        conf = {"mqtt": {"idle_timeout": "30s",
+                         "max_packet_size": "1MB"}}
+        assert check_schema(conf) == []
+        assert conf["mqtt"]["idle_timeout"] == 30
+        assert conf["mqtt"]["max_packet_size"] == 1048576
+
+    def test_type_errors(self):
+        errs = check_schema({"mqtt": {"max_inflight": "lots",
+                                      "retain_available": 3},
+                             "broker": "not-an-object"})
+        assert len(errs) == 3
+        assert any("max_inflight" in e for e in errs)
+        assert any("retain_available" in e for e in errs)
+        assert any("broker" in e for e in errs)
+
+    def test_unknown_keys_allowed(self):
+        assert check_schema({"my_plugin": {"weird": 1}}) == []
+
+
+class TestConfigFile:
+    def test_load_update_persist(self, tmp_path):
+        main = tmp_path / "emqx.conf"
+        main.write_text("""
+        mqtt { max_inflight = 12, idle_timeout = 20s }
+        broker.sys_msg_interval = 45
+        """)
+        conf = Config.load_file(str(main))
+        assert conf.get("mqtt", "max_inflight") == 12
+        assert conf.get("mqtt", "idle_timeout") == 20
+        assert conf.get("broker", "sys_msg_interval") == 45
+        # defaults still merged underneath
+        assert conf.get("mqtt", "max_qos_allowed") == 2
+
+        seen = []
+        conf.register_handler(("mqtt",),
+                              lambda p, v, c: seen.append((p, v)))
+        conf.update(("mqtt", "max_inflight"), 99)
+        assert seen == [(("mqtt", "max_inflight"), 99)]
+        assert conf.get("mqtt", "max_inflight") == 99
+        # persisted override survives a reload
+        conf2 = Config.load_file(str(main))
+        assert conf2.get("mqtt", "max_inflight") == 99
+
+    def test_override_file_survives_restart_updates(self, tmp_path):
+        # overrides persisted by a previous run must not be discarded by
+        # this run's first update()
+        main = tmp_path / "emqx.conf"
+        main.write_text("mqtt.max_inflight = 12\n")
+        c1 = Config.load_file(str(main))
+        c1.update(("mqtt", "max_inflight"), 64)
+        c2 = Config.load_file(str(main))
+        c2.update(("broker", "sys_msg_interval"), 99)
+        c3 = Config.load_file(str(main))
+        assert c3.get("mqtt", "max_inflight") == 64
+        assert c3.get("broker", "sys_msg_interval") == 99
+
+    def test_ssl_listener_without_certs_refused(self, tmp_path):
+        main = tmp_path / "emqx.conf"
+        main.write_text(
+            'listeners.bad { type = ssl, port = 0 }\n')
+        node = Node.from_config_file(str(main), use_device=False)
+        loop = asyncio.new_event_loop()
+        try:
+            with pytest.raises(ValueError):
+                loop.run_until_complete(node.start_listeners())
+        finally:
+            loop.close()
+
+    def test_handler_veto(self, tmp_path):
+        conf = Config()
+
+        def veto(path, value, _c):
+            raise ValueError("nope")
+        conf.register_handler(("broker",), veto)
+        with pytest.raises(ValueError):
+            conf.update(("broker", "sys_msg_interval"), 1)
+        assert conf.get("broker", "sys_msg_interval") == 60
+
+    def test_schema_error_on_boot(self, tmp_path):
+        bad = tmp_path / "bad.conf"
+        bad.write_text("mqtt.max_inflight = banana\n")
+        with pytest.raises(ValueError):
+            Config.load_file(str(bad))
+
+
+class TestNodeBootFromFile:
+    def test_listeners_from_config(self, tmp_path):
+        main = tmp_path / "emqx.conf"
+        main.write_text("""
+        listeners {
+          default  { type = tcp, bind = "127.0.0.1", port = 0 }
+          ws       { type = ws, bind = "127.0.0.1", port = 0 }
+          disabled { type = tcp, port = 0, enabled = false }
+        }
+        mqtt.max_inflight = 5
+        """)
+        node = Node.from_config_file(str(main), use_device=False)
+        loop = asyncio.new_event_loop()
+        try:
+            listeners = loop.run_until_complete(node.start_listeners())
+            assert len(listeners) == 2
+            tcp = listeners[0]
+
+            async def go():
+                c = Client(port=tcp.port, clientid="boot1")
+                await c.connect()
+                await c.subscribe("a/b")
+                await c.publish("a/b", b"hi")
+                m = await c.recv()
+                assert m.payload == b"hi"
+                await c.disconnect()
+            loop.run_until_complete(asyncio.wait_for(go(), 15))
+        finally:
+            loop.run_until_complete(node.stop_listeners())
+            loop.close()
