@@ -274,6 +274,8 @@ class Channel:
         process_connect half of emqx_channel handle_in CONNECT)."""
         clientid = self.clientid
         props = pkt.properties or {}
+        from emqx_tpu.utils.logger import set_metadata_clientid
+        set_metadata_clientid(clientid)
         # --- will message
         if pkt.will is not None:
             self.will_msg = make(

@@ -73,6 +73,8 @@ DEFAULTS: dict = {
         "ban_time": 300,
     },
     "force_shutdown": {"max_mqueue_len": 10000, "max_awaiting_rel": 0},
+    "conn_congestion": {"enable_alarm": False,
+                        "min_alarm_sustain_duration": 60},
     "rate_limit": {
         "max_conn_rate": 0,          # new connections/sec per listener
         "conn_messages_in": 0,       # packets/sec per connection
@@ -80,6 +82,7 @@ DEFAULTS: dict = {
         "quota_messages_routing": 0,  # publishes/sec per connection
     },
     "alarm": {"size_limit": 1000, "validity_period": 86400},
+    "log": {"enable": False, "level": "warning", "formatter": "text"},
     "sysmon": {"os": {"sysmem_high_watermark": 0.7,
                       "procmem_high_watermark": 0.05}},
     "rule_engine": {"rules": []},

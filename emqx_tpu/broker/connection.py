@@ -55,6 +55,13 @@ class Connection:
         fs = node.config.get_zone(zone, "force_shutdown") or {}
         self.force_shutdown = ForceShutdownPolicy(
             fs.get("max_mqueue_len", 0), fs.get("max_awaiting_rel", 0))
+        from emqx_tpu.broker.congestion import Congestion
+        cc = node.config.get_zone(zone, "conn_congestion") or {}
+        self.congestion = Congestion(
+            node, self.channel, writer,
+            enable_alarm=cc.get("enable_alarm", False),
+            min_alarm_sustain_duration=cc.get(
+                "min_alarm_sustain_duration", 60))
 
     # ---- outbound ----
     def _send_packets(self, pkts: list[P.Packet]) -> None:
@@ -72,6 +79,10 @@ class Connection:
 
     # ---- main loop (emqx_connection:recvloop) ----
     async def run(self) -> None:
+        from emqx_tpu.utils.logger import set_metadata_peername
+        peer = self.channel.conninfo.get("peername")
+        if peer:
+            set_metadata_peername(f"{peer[0]}:{peer[1]}")
         self._timer_task = asyncio.ensure_future(self._timers())
         reason = "closed"
         try:
@@ -124,13 +135,26 @@ class Connection:
         finally:
             if self._timer_task:
                 self._timer_task.cancel()
+            self.congestion.cancel()
             self.channel.terminate(self._closing or reason)
             try:
+                # graceful close first (flushes the DISCONNECT we may have
+                # just written); a stuck peer that can never drain falls
+                # into the timeout and gets hard-aborted
                 if not self.writer.is_closing():
                     self.writer.close()
-                await self.writer.wait_closed()
-            except Exception:
-                pass
+                await asyncio.wait_for(self.writer.wait_closed(), 5)
+            except (asyncio.CancelledError, KeyboardInterrupt, SystemExit):
+                try:
+                    self.writer.transport.abort()
+                except Exception:
+                    pass
+                raise               # preserve the cancellation contract
+            except Exception:       # TimeoutError, reset mid-flush, ...
+                try:
+                    self.writer.transport.abort()
+                except Exception:
+                    pass
 
     def _frame_error_out(self, e: FrameError) -> None:
         if self.channel.proto_ver == C.MQTT_V5 and \
@@ -160,6 +184,7 @@ class Connection:
         while True:
             await asyncio.sleep(1.0)
             now = time.monotonic()
+            self.congestion.check()
             ka = self.channel.keepalive
             if (ka and self.channel.conn_state == "connected"
                     and now - self.last_rx > ka * 2 * backoff):

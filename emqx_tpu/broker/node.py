@@ -30,6 +30,8 @@ class Node:
         from emqx_tpu.broker.config import Config
         self.name = name
         self.config = config if hasattr(config, "get_zone") else Config(config)
+        from emqx_tpu.utils.logger import setup_from_config
+        setup_from_config(self.config.get("log") or {})
         self.hooks = Hooks()
         self.metrics = Metrics()
         self.stats = Stats()

@@ -113,6 +113,10 @@ class ClusterNode:
             self.registry_register(clientid)
 
     async def stop(self) -> None:
+        dt = getattr(self, "_discovery_task", None)
+        if dt is not None:      # etcd lease keepalive (discovery.py)
+            dt.cancel()
+            self._discovery_task = None
         if self._repl_task:
             try:
                 await asyncio.wait_for(self._repl_q.join(), 2)

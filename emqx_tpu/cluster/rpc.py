@@ -241,7 +241,12 @@ class RpcNode:
                     asyncio.create_task(self._run_cast(msg))
         finally:
             self._inbound.discard(writer)
-            writer.close()
+            try:
+                writer.close()
+            except RuntimeError:
+                # loop already closed (interpreter teardown sweeping a
+                # still-parked serve coroutine) — nothing left to close
+                pass
 
     async def _run_call(self, writer: asyncio.StreamWriter,
                         msg: dict) -> None:

@@ -21,7 +21,12 @@ log = logging.getLogger("emqx_tpu.native")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libemqx_native.so")
+# EMQX_NATIVE_LIB overrides the library path (sanitizer builds:
+# native/Makefile test-asan / test-tsan targets)
+_LIB_PATH = os.environ.get("EMQX_NATIVE_LIB") or \
+    os.path.join(_NATIVE_DIR, "libemqx_native.so")
+if not os.path.isabs(_LIB_PATH):
+    _LIB_PATH = os.path.join(os.path.dirname(_NATIVE_DIR), _LIB_PATH)
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False

@@ -235,3 +235,147 @@ class TestLimiterEndToEnd:
             loop.run_until_complete(asyncio.wait_for(go(), 15))
         finally:
             loop.run_until_complete(lst.stop())
+
+
+class TestCongestion:
+    """emqx_congestion.erl analog: write-buffer congestion alarms with
+    sustain-duration hysteresis."""
+
+    def test_alarm_lifecycle(self):
+        from emqx_tpu.broker.congestion import Congestion
+
+        class FakeTransport:
+            def __init__(self):
+                self.size = 0
+
+            def get_write_buffer_size(self):
+                return self.size
+
+        class FakeWriter:
+            def __init__(self):
+                self.transport = FakeTransport()
+
+        node = Node(use_device=False)
+
+        class Ch:
+            clientid = "c1"
+            clientinfo = {"username": "u1"}
+            conninfo = {"peername": ("127.0.0.1", 1)}
+            conn_state = "connected"
+
+        w = FakeWriter()
+        cg = Congestion(node, Ch(), w, enable_alarm=True,
+                        min_alarm_sustain_duration=0.05)
+        cg.check()
+        assert not node.alarms.get_alarms("activated")  # not congested yet
+        w.transport.size = 4096
+        cg.check()
+        acts = node.alarms.get_alarms("activated")
+        assert any(a["name"] == "conn_congestion/c1/u1" for a in acts)
+        # still congested: stays active
+        cg.check()
+        assert node.alarms.get_alarms("activated")
+        # drained, but within sustain window: still active
+        w.transport.size = 0
+        cg.check()
+        assert node.alarms.get_alarms("activated")
+        time.sleep(0.06)
+        cg.check()
+        assert not node.alarms.get_alarms("activated")
+
+    def test_disabled_noop(self):
+        from emqx_tpu.broker.congestion import Congestion
+        node = Node(use_device=False)
+
+        class Ch:
+            clientid = "c"
+            clientinfo = {}
+            conninfo = {}
+            conn_state = "connected"
+
+        class W:
+            transport = None
+        cg = Congestion(node, Ch(), W())
+        cg.check()
+        cg.cancel()
+        assert not node.alarms.get_alarms("activated")
+
+
+class TestLogFormatters:
+    """emqx_logger_jsonfmt/textfmt + metadata scoping."""
+
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def test_json_formatter_with_metadata(self):
+        import json as _json
+        import logging as _logging
+
+        from emqx_tpu.utils import logger as L
+        records = []
+
+        class Cap(_logging.Handler):
+            def emit(self, record):
+                records.append(self.format(record))
+
+        h = Cap()
+        h.setFormatter(L.JsonFormatter())
+        h.addFilter(L.MetadataFilter())
+        lg = _logging.getLogger("emqx_tpu.testjson")
+        lg.addHandler(h)
+        lg.setLevel(_logging.INFO)
+        try:
+            L.set_metadata_clientid("cli-9")
+            L.set_metadata_peername("10.0.0.9:1234")
+            lg.info("client subscribed %s", "t/1")
+            out = _json.loads(records[0])
+            assert out["msg"] == "client subscribed t/1"
+            assert out["level"] == "info"
+            assert out["clientid"] == "cli-9"
+            assert out["peername"] == "10.0.0.9:1234"
+            assert isinstance(out["time"], int)
+        finally:
+            lg.removeHandler(h)
+            L.clear_metadata()
+
+    def test_json_formatter_unjsonable_extra(self):
+        import json as _json
+        import logging as _logging
+
+        from emqx_tpu.utils import logger as L
+        f = L.JsonFormatter()
+        rec = _logging.makeLogRecord(
+            {"msg": "x", "levelname": "INFO", "name": "n",
+             "payload": b"\xff\xfe", "obj": object()})
+        out = _json.loads(f.format(rec))
+        assert "payload" in out and "obj" in out
+
+    def test_text_formatter(self):
+        import logging as _logging
+
+        from emqx_tpu.utils import logger as L
+        f = L.TextFormatter()
+        rec = _logging.makeLogRecord(
+            {"msg": "hello", "levelname": "WARNING", "name": "n"})
+        rec.emqx_metadata = {"clientid": "c1", "peername": "1.2.3.4:5"}
+        line = f.format(rec)
+        assert "[warning]" in line and "c1@1.2.3.4:5:" in line \
+            and "hello" in line
+
+    def test_metadata_isolated_per_task(self, loop):
+        from emqx_tpu.utils import logger as L
+
+        async def task(cid, out):
+            L.set_metadata_clientid(cid)
+            await asyncio.sleep(0.01)
+            out[cid] = dict(L._log_metadata.get())
+
+        async def go():
+            out = {}
+            await asyncio.gather(task("a", out), task("b", out))
+            assert out["a"]["clientid"] == "a"
+            assert out["b"]["clientid"] == "b"
+        loop.run_until_complete(go())
