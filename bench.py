@@ -229,8 +229,46 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         f"{matches_per_sec / 1e6:.1f}M topic-matches/s "
         f"({window} batches of {B})")
 
+    # --- xla vs pallas fold backends (match-only, same tables/batch) -----
+    # VERDICT item 6: the Pallas kernel (ops/pallas_fold.py) fuses the
+    # shape-hash fold; both backends must agree bit-for-bit and both get a
+    # measured number here. Best-effort: never kills the core result.
+    pallas_fields = {}
+    try:
+        from emqx_tpu.ops.shapes import shape_match, shape_match_pallas
+        tb, lb, db, _ = staged[0]
+        rx = shape_match(tables.shapes, tb, lb, db)
+        rp = shape_match_pallas(tables.shapes, tb, lb, db)
+        same = bool((np.asarray(rx.matches) == np.asarray(rp.matches)).all())
+
+        def _match_window(fn, n=16):
+            acc = _put_retry(np.int32(0))
+            t0 = time.time()
+            for i in range(n):
+                t_, l_, d_, _ = staged[i % 8]
+                r = fn(tables.shapes, t_, l_, d_)
+                acc = acc + r.matches.sum(dtype=np.int32)
+            _ = int(np.asarray(acc))
+            return B * n / (time.time() - t0)
+
+        _match_window(shape_match, 2)          # warm
+        _match_window(shape_match_pallas, 2)
+        xla_ps = _match_window(shape_match)
+        pallas_ps = _match_window(shape_match_pallas)
+        pallas_fields = {
+            "match_xla_per_s": round(xla_ps),
+            "match_pallas_per_s": round(pallas_ps),
+            "pallas_bit_identical": same,
+        }
+        log(f"fold backends: xla {xla_ps / 1e6:.1f}M/s, "
+            f"pallas {pallas_ps / 1e6:.1f}M/s, bit-identical={same}")
+    except Exception as e:  # noqa: BLE001
+        log(f"pallas comparison failed: {type(e).__name__}: {e}")
+        pallas_fields = {"pallas_error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     target = 5_000_000.0
     return {
+        **pallas_fields,
         "metric": f"topic_matches_per_sec_at_{subs // 1_000_000}M_subs"
                   if subs >= 1_000_000 else
                   f"topic_matches_per_sec_at_{subs // 1000}k_subs",

@@ -47,8 +47,12 @@ class StaticDiscovery(Discovery):
         self._seeds = []
         for s in seeds:
             if isinstance(s, str):
-                host, _, port = s.rpartition(":")
-                self._seeds.append((host, int(port)))
+                host, sep, port = s.rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(
+                        f"static discovery seed {s!r} must be "
+                        f"\"host:port\" (IPv6: \"[addr]:port\")")
+                self._seeds.append((host.strip("[]"), int(port)))
             else:
                 self._seeds.append((s[0], int(s[1])))
 
@@ -151,10 +155,15 @@ class EtcdDiscovery(Discovery):
                     f"{host}:{port}".encode()).decode()}
         if lease_id is not None:
             body["lease"] = lease_id
-        await request("POST", self.server + "/v3/kv/put",
-                      body=json.dumps(body).encode(),
-                      headers={"content-type": "application/json"},
-                      timeout=self.timeout)
+        try:
+            await request("POST", self.server + "/v3/kv/put",
+                          body=json.dumps(body).encode(),
+                          headers={"content-type": "application/json"},
+                          timeout=self.timeout)
+        except Exception as e:  # noqa: BLE001 — degrade like discover()
+            log.warning("etcd registration failed (node stays "
+                        "unregistered): %s", e)
+            return None
         return lease_id
 
     async def keepalive_loop(self, lease_id: str, ttl: int = 60) -> None:

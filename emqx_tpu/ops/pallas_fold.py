@@ -1,0 +1,110 @@
+"""Pallas TPU kernel for the shape-hash fold (the matcher's VPU core).
+
+The shape-directed matcher (ops/shapes.py, replacing the reference's
+per-message trie walk, emqx_trie.erl:208-266) spends its compute in a
+per-level hash fold over [batch, shapes] lanes followed by two-choice home
+bucket derivation and shape-compatibility masking. This kernel fuses the
+whole L-level fold, the home computation, and the compatibility mask into
+ONE VMEM-resident Pallas program (grid over batch blocks), so the level
+loop never materializes intermediates in HBM and the mask/index outputs
+come out in a single pass. The two bucket-row gathers stay in XLA (Mosaic
+has no large-table vector gather; the gather is HBM-bound either way).
+
+Bit-exactness: identical uint32 arithmetic to the jnp path — the oracle
+tests assert h1/h2/compat equality against ops.shapes.shape_match's fold,
+so either backend can serve the same tables.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from emqx_tpu.ops.shapes import _fold, _homes, _seed
+
+_U = np.uint32
+
+
+def _fold_kernel(L: int, NB: int, topics_ref, lens_ref, dollar_ref,
+                 spm_ref, slen_ref, shh_ref, swr_ref,
+                 h1_ref, h2_ref, b1_ref, b2_ref, compat_ref):
+    Bb = topics_ref.shape[0]
+    NSc = spm_ref.shape[1]
+    sid = jax.lax.broadcasted_iota(jnp.int32, (Bb, NSc), 1)
+    h1 = _seed(sid, 0x27D4EB2F, 0x165667B1)
+    h2 = _seed(sid, 0x85EBCA6B, 0xC2B2AE3D)
+    slen = slen_ref[:]                       # [1, NSc]
+    pmask = spm_ref[:]
+    for l in range(L):
+        concrete = (l < slen) & ((pmask >> l) & 1 == 0)
+        w = topics_ref[:, l:l + 1].astype(jnp.uint32)
+        h1 = jnp.where(concrete, _fold(h1, w, 2 * l), h1)
+        h2 = jnp.where(concrete, _fold(h2, w, 2 * l + 1), h2)
+    lens_ = lens_ref[:]                      # [Bb, 1]
+    # int32 arithmetic throughout: Mosaic cannot truncate i8->i1, so
+    # boolean select/and chains must stay integer-typed in-kernel
+    len_ok = jnp.where(shh_ref[:] == 1,
+                       (lens_ >= slen).astype(jnp.int32),
+                       (lens_ == slen).astype(jnp.int32))
+    real_shape = (slen >= 0).astype(jnp.int32)
+    dollar_block = ((dollar_ref[:] != 0) & (swr_ref[:] == 1)
+                    ).astype(jnp.int32)
+    nonempty = (lens_ > 0).astype(jnp.int32)
+    compat = len_ok * real_shape * (1 - dollar_block) * nonempty
+    b1, b2 = _homes(h1, h2, NB)
+    h1_ref[:] = h1.astype(jnp.int32)
+    h2_ref[:] = h2.astype(jnp.int32)
+    b1_ref[:] = b1.astype(jnp.int32)
+    b2_ref[:] = b2.astype(jnp.int32)
+    compat_ref[:] = compat
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("L", "NB", "block_b", "interpret"))
+def shape_fold_pallas(topics: jax.Array, lens: jax.Array,
+                      is_dollar: jax.Array, spm: jax.Array,
+                      slen: jax.Array, shh: jax.Array, swr: jax.Array,
+                      *, L: int, NB: int, block_b: int = 256,
+                      interpret: bool = None):
+    """Fused fold: -> (h1, h2, b1, b2, compat) each [B, NSc] int32."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B = topics.shape[0]
+    NSc = spm.shape[0]
+    Bb = min(block_b, B)
+    nb = -(-B // Bb)
+    Bp = nb * Bb
+    if Bp != B:
+        topics = jnp.pad(topics, ((0, Bp - B), (0, 0)))
+        lens = jnp.pad(lens, (0, Bp - B))
+        is_dollar = jnp.pad(is_dollar, (0, Bp - B))
+    out_shape = [jax.ShapeDtypeStruct((Bp, NSc), jnp.int32)] * 5
+    grid = (nb,)
+    bspec = pl.BlockSpec((Bb, NSc), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((1, NSc), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    h1, h2, b1, b2, compat = pl.pallas_call(
+        functools.partial(_fold_kernel, L, NB),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Bb, topics.shape[1]), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Bb, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Bb, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            sspec, sspec, sspec, sspec,
+        ],
+        out_specs=[bspec] * 5,
+        interpret=interpret,
+    )(topics, lens[:, None].astype(jnp.int32),
+      is_dollar[:, None].astype(jnp.int32),
+      spm[None, :], slen[None, :], shh[None, :], swr[None, :])
+    return (h1[:B], h2[:B], b1[:B], b2[:B], compat[:B])

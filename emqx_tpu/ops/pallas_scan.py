@@ -1,0 +1,94 @@
+"""Pallas TPU kernel: long 1D inclusive prefix sum.
+
+XLA's native lowering of 1D cumsum on this TPU generation is pathological
+(measured in round 1: 139ms at 524k elements; ops/scan_ops.py works around
+it with lower-triangular matmuls from the host side). This kernel does the
+same MXU reformulation *inside one Pallas program*: grid over blocks
+(sequential on a TPU core), each step computes its within-block prefix
+with one [R,128]x[128,128] lower-triangular matmul + a tiny row-offset
+loop, and carries the running total across steps in SMEM scratch — no
+cross-block HBM round trips and no host-side stitch.
+
+Used by the shared-subscription rank-over-runs (ops/shared.py) and
+benchmarked against both jnp.cumsum and ops.scan_ops.cumsum_blocked.
+Exact for values whose running total stays under 2^24 (float32 mantissa);
+inputs on this path are 0/1 run-start flags.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BS = 1024                 # elements per grid step
+_R = BS // 128
+_LT = np.tril(np.ones((128, 128), np.float32))
+# strictly-lower-triangular row mixer: row r picks up all rows < r
+# (Mosaic has no cumsum primitive, so cross-row offsets are a matmul too)
+_LTR = np.tril(np.ones((_R, _R), np.float32), k=-1)
+
+
+def _scan_kernel(x_ref, lt_ref, ltr_ref, out_ref, carry_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[0, 0] = jnp.float32(0)
+
+    x = x_ref[:].astype(jnp.float32)              # [R, 128]
+    # within-row (128-lane) inclusive prefix on the MXU
+    within = jax.lax.dot_general(
+        x, lt_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [R, 128]
+    # cross-row offsets: sum of all earlier rows, per lane then reduced
+    prev_rows = jax.lax.dot_general(
+        ltr_ref[:], x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [R, 128]
+    row_off = prev_rows.sum(axis=1, keepdims=True)  # [R, 1]
+    carry = carry_ref[0, 0]
+    out = within + row_off + carry
+    out_ref[:] = out.astype(jnp.int32)
+    carry_ref[0, 0] = carry + x.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def prefix_sum_pallas(x: jax.Array, *,
+                      interpret: bool = None) -> jax.Array:
+    """Inclusive prefix sum of a 1D int32 array.
+
+    Exact only while the RUNNING TOTAL stays under 2^24 (float32
+    accumulation); the length guard below enforces this for the 0/1-flag
+    inputs this path carries — callers with larger element values must
+    bound n * max(x) themselves.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = x.shape[0]
+    if n > (1 << 24):
+        raise ValueError(
+            f"prefix_sum_pallas: length {n} exceeds the float32-exact "
+            f"bound 2^24")
+    nb = max(1, -(-n // BS))
+    pad = nb * BS - n
+    xb = jnp.pad(x, (0, pad)).reshape(nb * _R, 128)
+    out = pl.pallas_call(
+        _scan_kernel,
+        out_shape=jax.ShapeDtypeStruct((nb * _R, 128), jnp.int32),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((_R, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((128, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((_R, _R), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((_R, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.float32)],
+        interpret=interpret,
+    )(xb, jnp.asarray(_LT), jnp.asarray(_LTR))
+    return out.reshape(-1)[:n]

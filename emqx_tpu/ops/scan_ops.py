@@ -1,12 +1,16 @@
 """Prefix-scan primitives that map onto the MXU.
 
-XLA's associative-scan lowering for long 1D arrays is pathologically slow on
-this TPU generation (measured: jnp.cumsum 139ms, jnp.maximum.accumulate
-1.15s at 524k elements), so long scans are reformulated as block matmuls
-against a lower-triangular ones matrix: prefix-within-block on the MXU
-(one [nb,BS]x[BS,BS] contraction) plus a short cross-block cumsum.
-Exact for values up to 2^24 per float32 mantissa; inputs here are 0/1 flags
-and small counts, far below that.
+XLA's associative-scan lowering for long 1D arrays was pathologically slow
+on the round-1 libtpu (measured then: jnp.cumsum 139ms,
+jnp.maximum.accumulate 1.15s at 524k elements), so long scans are
+reformulated as block matmuls against a lower-triangular ones matrix:
+prefix-within-block on the MXU (one [nb,BS]x[BS,BS] contraction) plus a
+short cross-block cumsum. Re-measured on the current runtime the three
+variants (native cumsum, this, ops/pallas_scan.prefix_sum_pallas) are at
+parity (~1us/scan at 524k inside a fused loop) — the reformulation is kept
+as the default and the bench records the comparison. Exact for values up
+to 2^24 per float32 mantissa; inputs here are 0/1 flags and small counts,
+far below that.
 """
 
 from __future__ import annotations

@@ -279,20 +279,13 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
         buckets=buckets, n_shapes=np.int32(NS), n_filters=np.int32(F))
 
 
-@jax.jit
-def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
-                is_dollar: jax.Array) -> MatchResult:
-    """Match a topic batch against all shapes: two bucket gathers per shape.
-
-    Returns MatchResult with matches [B, NS] (each shape contributes at most
-    one filter id, -1 otherwise); counts [B]; overflow always False (the
-    output is exhaustive by construction: every filter lives in one of its
-    two home buckets).
-    """
+def _fold_xla(st: ShapeTables, topics: jax.Array, lens: jax.Array,
+              is_dollar: jax.Array):
+    """Per-level hash fold + compatibility + homes (the XLA backend).
+    -> (h1, h2, b1, b2, compatible), hashes uint32."""
     B, L = topics.shape
     NSc = st.shape_plus_mask.shape[0]
     NB = st.buckets.shape[0]
-
     sid = jax.lax.broadcasted_iota(jnp.int32, (1, NSc), 1)
     h1 = jnp.broadcast_to(_seed(sid, 0x27D4EB2F, 0x165667B1), (B, NSc))
     h2 = jnp.broadcast_to(_seed(sid, 0x85EBCA6B, 0xC2B2AE3D), (B, NSc))
@@ -310,10 +303,17 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
     compatible &= slen >= 0
     compatible &= ~(is_dollar[:, None] & (st.shape_wild_root[None, :] == 1))
     compatible &= lens_ > 0  # batch-padding rows match nothing
-
     b1, b2 = _homes(h1, h2, NB)
+    return h1, h2, b1, b2, compatible
+
+
+def _probe_buckets(st: ShapeTables, h1, h2, b1, b2,
+                   compatible) -> MatchResult:
+    """Two bucket row-gathers + hash compare (shared by both backends)."""
+    B = h1.shape[0]
     h1i = h1.astype(jnp.int32)[..., None]
     h2i = h2.astype(jnp.int32)[..., None]
+    compatible = compatible.astype(bool)
 
     def probe(home):
         rows = st.buckets[home.astype(jnp.int32)]  # [B, NSc, 3*BK] gather
@@ -330,3 +330,31 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
     counts = (matches >= 0).sum(axis=-1, dtype=jnp.int32)
     return MatchResult(matches=matches, counts=counts,
                        overflow=jnp.zeros(B, bool))
+
+
+@jax.jit
+def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
+                is_dollar: jax.Array) -> MatchResult:
+    """Match a topic batch against all shapes: two bucket gathers per shape.
+
+    Returns MatchResult with matches [B, NS] (each shape contributes at most
+    one filter id, -1 otherwise); counts [B]; overflow always False (the
+    output is exhaustive by construction: every filter lives in one of its
+    two home buckets).
+    """
+    h1, h2, b1, b2, compatible = _fold_xla(st, topics, lens, is_dollar)
+    return _probe_buckets(st, h1, h2, b1, b2, compatible)
+
+
+@jax.jit
+def shape_match_pallas(st: ShapeTables, topics: jax.Array,
+                       lens: jax.Array,
+                       is_dollar: jax.Array) -> MatchResult:
+    """shape_match with the fold stage as a fused Pallas kernel
+    (ops/pallas_fold.py); bit-identical results by construction."""
+    from emqx_tpu.ops.pallas_fold import shape_fold_pallas
+    h1, h2, b1, b2, compat = shape_fold_pallas(
+        topics, lens.astype(jnp.int32), is_dollar,
+        st.shape_plus_mask, st.shape_len, st.shape_has_hash,
+        st.shape_wild_root, L=topics.shape[1], NB=st.buckets.shape[0])
+    return _probe_buckets(st, h1, h2, b1, b2, compat)

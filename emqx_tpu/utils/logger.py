@@ -111,6 +111,9 @@ def setup(level: int = logging.INFO, fmt: str = "text",
     root = logging.getLogger("emqx_tpu")
     root.addHandler(handler)
     root.setLevel(level)
+    # this handler is the namespace's sink: without this, records also
+    # propagate to any root handler and print twice
+    root.propagate = False
     return handler
 
 
