@@ -114,6 +114,17 @@ class Node:
                                  **common)
             elif ltype in ("tcp", "ssl"):
                 lst = Listener(self, name=f"{ltype}:{name}", **common)
+            elif ltype == "quic":
+                from emqx_tpu.quic import QuicListener
+                ssl_opts = lc.get("ssl") or {}
+                if not ssl_opts.get("certfile") or \
+                        not ssl_opts.get("keyfile"):
+                    raise ValueError(
+                        f"quic listener {name!r} needs ssl.certfile and "
+                        f"ssl.keyfile")
+                common.pop("ssl_opts", None)
+                lst = QuicListener(self, certfile=ssl_opts["certfile"],
+                                   keyfile=ssl_opts["keyfile"], **common)
             else:
                 raise ValueError(f"unknown listener type {ltype!r}")
             await lst.start()

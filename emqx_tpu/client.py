@@ -26,7 +26,12 @@ class Client:
                  password: Optional[bytes] = None, clean_start: bool = True,
                  keepalive: int = 0, proto_ver: int = C.MQTT_V4,
                  properties: Optional[dict] = None,
-                 will: Optional[P.Will] = None, ssl=None):
+                 will: Optional[P.Will] = None, ssl=None,
+                 conn_factory=None):
+        # conn_factory: async () -> (reader, writer) for non-TCP
+        # transports (the QUIC stream pair; the reference's emqtt takes a
+        # quic option the same way)
+        self._conn_factory = conn_factory
         self.host, self.port = host, port
         # ssl: an ssl.SSLContext, or a dict of emqx-style client tls opts
         if isinstance(ssl, dict):
@@ -91,8 +96,11 @@ class Client:
         return self._next_pid
 
     async def connect(self, timeout: float = 5.0) -> P.Connack:
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self.ssl)
+        if self._conn_factory is not None:
+            self._reader, self._writer = await self._conn_factory()
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port, ssl=self.ssl)
         pkt = P.Connect(
             proto_name=C.PROTOCOL_NAMES[self.proto_ver],
             proto_ver=self.proto_ver, clean_start=self.clean_start,

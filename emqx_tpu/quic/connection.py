@@ -1,0 +1,657 @@
+"""QUIC v1 endpoint machinery + the MQTT-over-QUIC server listener.
+
+Parity: apps/emqx/src/emqx_quic_connection.erl + emqx_quic_stream.erl —
+there thin callbacks over msquic; here the full endpoint: packet-number
+spaces, CRYPTO reassembly, immediate-ACK policy, stream demux. Each
+client-initiated bidirectional stream is bridged to the ordinary broker
+`Connection` (same Channel/FSM the TCP and WS listeners feed), exactly
+like the reference treats one QUIC stream as one MQTT transport.
+
+Loss handling: ACKs are generated for every ack-eliciting packet and
+un-acked CRYPTO flights are retransmitted on a coarse PTO timer —
+sufficient for the low-loss links MQTT-over-QUIC targets; there is no
+congestion controller (the reference delegates that to msquic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+from emqx_tpu.quic import frames as F
+from emqx_tpu.quic import packet as P
+from emqx_tpu.quic import tls13 as T
+
+log = logging.getLogger("emqx_tpu.quic")
+
+CID_LEN = 8
+MAX_DATAGRAM = 1350
+STREAM_WINDOW = 1 << 20        # per-stream flow-control credit
+CONN_WINDOW = 1 << 22
+PTO_S = 0.3
+IDLE_TIMEOUT_S = 30.0
+
+_LVL_OF_PTYPE = {P.PT_INITIAL: T.INITIAL, P.PT_HANDSHAKE: T.HANDSHAKE,
+                 P.PT_ONE_RTT: T.APPLICATION}
+_PTYPE_OF_LVL = {T.INITIAL: P.PT_INITIAL, T.HANDSHAKE: P.PT_HANDSHAKE,
+                 T.APPLICATION: P.PT_ONE_RTT}
+
+
+class _CryptoReassembly:
+    def __init__(self):
+        self.next = 0
+        self.frags: dict[int, bytes] = {}
+
+    def feed(self, offset: int, data: bytes) -> bytes:
+        if offset > self.next:
+            self.frags[offset] = data
+            return b""
+        out = data[self.next - offset:] if offset < self.next else data
+        self.next += len(out)
+        while self.frags:
+            off = min(self.frags)
+            if off > self.next:
+                break
+            d = self.frags.pop(off)
+            tail = d[self.next - off:] if off < self.next else d
+            out += tail
+            self.next += len(tail)
+        return out
+
+
+class _RecvStream:
+    def __init__(self):
+        self.reassembly = _CryptoReassembly()
+        self.fin_at: Optional[int] = None
+        self.delivered = 0
+        self.credit = STREAM_WINDOW     # last advertised rx limit
+
+
+class _Space:
+    """One packet-number space (initial/handshake/app)."""
+
+    def __init__(self):
+        self.next_pn = 0
+        self.largest_rx = -1
+        self.rx_floor = -1            # every pn <= floor was received
+        self.rx_pns: set[int] = set()  # received pns above the floor
+        self.ack_due = False
+        self.crypto_rx = _CryptoReassembly()
+        # pn -> (ts, payload, ack_eliciting)
+        self.unacked: dict[int, tuple[float, bytes, bool]] = {}
+
+    def record_rx(self, pn: int) -> bool:
+        """Track a received pn; False if duplicate. Compresses the
+        contiguous prefix into rx_floor so state stays O(reorder window)."""
+        if pn <= self.rx_floor or pn in self.rx_pns:
+            return False
+        self.rx_pns.add(pn)
+        self.largest_rx = max(self.largest_rx, pn)
+        while self.rx_floor + 1 in self.rx_pns:
+            self.rx_floor += 1
+            self.rx_pns.discard(self.rx_floor)
+        return True
+
+
+class QuicConnectionBase:
+    is_client = False
+
+    def __init__(self, transport: asyncio.DatagramTransport,
+                 addr, scid: bytes, dcid: bytes):
+        self.transport = transport
+        self.addr = addr
+        self.scid = scid
+        self.dcid = dcid
+        self.spaces = {lvl: _Space() for lvl in (0, 1, 2)}
+        self.keys_rx: dict[int, P.Keys] = {}
+        self.keys_tx: dict[int, P.Keys] = {}
+        self.tls: Optional[T._Base] = None
+        self.streams_rx: dict[int, _RecvStream] = {}
+        self.stream_tx_offset: dict[int, int] = {}
+        self._out_frames: dict[int, list[bytes]] = {0: [], 1: [], 2: []}
+        self.closed = False
+        self.close_reason = ""
+        self.last_rx = time.monotonic()
+        self.handshake_done = asyncio.get_event_loop().create_future()
+        self._pto_task: Optional[asyncio.Task] = None
+        # peer flow-control limits (from transport params, then MAX_*)
+        self.peer_max_stream_data = 1 << 16
+        self.peer_max_data = 1 << 18
+        self._stream_tx_limit: dict[int, int] = {}
+        self._blocked_tx: dict[int, tuple[bytes, bool]] = {}
+        self._tx_total = 0
+
+    # ---- tls plumbing ----
+    def _setup_initial_keys(self, initial_dcid: bytes) -> None:
+        client, server = P.initial_secrets(initial_dcid)
+        mine, theirs = (client, server) if self.is_client \
+            else (server, client)
+        self.keys_tx[0] = P.derive_keys(mine)
+        self.keys_rx[0] = P.derive_keys(theirs)
+
+    def _pump_tls(self) -> None:
+        for level, data in self.tls.pending:
+            sp = self.spaces[level]
+            off = getattr(sp, "crypto_tx_offset", 0)
+            pos = 0
+            while pos < len(data):
+                chunk = data[pos:pos + 1000]
+                self._out_frames[level].append(
+                    F.encode_crypto(off + pos, chunk))
+                pos += len(chunk)
+            sp.crypto_tx_offset = off + len(data)
+        self.tls.pending.clear()
+        if self.tls.peer_transport_params is not None and \
+                not getattr(self, "_tp_applied", False):
+            self._tp_applied = True
+            self._apply_peer_transport_params()
+        for level, (client_s, server_s) in self.tls.secrets.items():
+            if level not in self.keys_tx:
+                mine, theirs = (client_s, server_s) if self.is_client \
+                    else (server_s, client_s)
+                self.keys_tx[level] = P.derive_keys(mine)
+                self.keys_rx[level] = P.derive_keys(theirs)
+
+    # ---- inbound ----
+    def datagram_received(self, datagram: bytes) -> None:
+        pos = 0
+        while pos < len(datagram):
+            try:
+                ptype, dcid, scid, token, pn_off, end = P.peek_header(
+                    datagram, pos, CID_LEN)
+            except (IndexError, ValueError):
+                return
+            if ptype == P.PT_RETRY or ptype == P.PT_ZERO_RTT:
+                pos = end if end > pos else len(datagram)
+                continue
+            level = _LVL_OF_PTYPE[ptype]
+            keys = self.keys_rx.get(level)
+            if keys is None:
+                return                       # keys not ready: drop rest
+            sp = self.spaces[level]
+            try:
+                pkt = P.decode_packet(datagram, pos, ptype, pn_off, end,
+                                      keys, sp.largest_rx)
+            except P.PacketError:
+                pos = end if end > pos else len(datagram)
+                continue
+            if self.is_client and level == 0 and scid and \
+                    self.dcid != scid:
+                self.dcid = scid             # adopt server's chosen CID
+            pos = end if end > pos else len(datagram)
+            if not sp.record_rx(pkt.pn):
+                continue
+            self.last_rx = time.monotonic()
+            try:
+                self._handle_frames(level, F.parse_frames(pkt.payload))
+            except (F.FrameError, T.TlsError) as e:
+                self.close(0x0A if isinstance(e, F.FrameError) else
+                           0x100 + getattr(e, "alert", 80), str(e))
+                return
+        self.flush()
+
+    def _handle_frames(self, level: int, frames: list) -> None:
+        sp = self.spaces[level]
+        for fr in frames:
+            if isinstance(fr, F.Ack):
+                for lo, hi in fr.ranges:
+                    for pn in list(sp.unacked):
+                        if lo <= pn <= hi:
+                            del sp.unacked[pn]
+                continue
+            sp.ack_due = True
+            if isinstance(fr, F.Crypto):
+                data = sp.crypto_rx.feed(fr.offset, fr.data)
+                if data:
+                    self.tls.feed_crypto(level, data)
+                    self._pump_tls()
+                    self._after_tls_progress()
+            elif isinstance(fr, F.Stream):
+                self._on_stream_frame(fr)
+            elif isinstance(fr, F.Close):
+                self.closed = True
+                self.close_reason = fr.reason
+                self._on_closed()
+            elif isinstance(fr, F.HandshakeDone):
+                self._on_handshake_done_frame()
+            elif isinstance(fr, F.MaxStreamData):
+                cur = self._stream_tx_limit.get(
+                    fr.stream_id, self.peer_max_stream_data)
+                self._stream_tx_limit[fr.stream_id] = max(cur, fr.value)
+                self._drain_blocked()
+            elif isinstance(fr, F.MaxData):
+                self.peer_max_data = max(self.peer_max_data, fr.value)
+                self._drain_blocked()
+            elif isinstance(fr, (F.Ping, F.ResetStream)):
+                pass
+
+    # ---- outbound ----
+    def send_stream(self, stream_id: int, data: bytes,
+                    fin: bool = False) -> None:
+        off = self.stream_tx_offset.get(stream_id, 0)
+        if not data:
+            if fin:
+                self._out_frames[2].append(
+                    F.encode_stream(stream_id, off, b"", fin=True))
+            return
+        # peer flow control: send only what the advertised windows allow;
+        # the excess queues until MAX_STREAM_DATA/MAX_DATA credit arrives
+        limit = self._stream_tx_limit.get(stream_id,
+                                          self.peer_max_stream_data)
+        allow = min(limit - off, self.peer_max_data - self._tx_total)
+        if allow < len(data):
+            take = max(0, allow)
+            prev, _ = self._blocked_tx.get(stream_id, (b"", False))
+            self._blocked_tx[stream_id] = (prev + data[take:], fin)
+            data = data[:take]
+            fin = False
+            if not data:
+                return
+        elif stream_id in self._blocked_tx:
+            # keep ordering: earlier bytes are still queued
+            prev, _ = self._blocked_tx[stream_id]
+            self._blocked_tx[stream_id] = (prev + data, fin)
+            return
+        pos = 0
+        while pos < len(data):
+            chunk = data[pos:pos + 1000]
+            last = pos + len(chunk) >= len(data)
+            self._out_frames[2].append(F.encode_stream(
+                stream_id, off + pos, chunk, fin=fin and last))
+            pos += len(chunk)
+        self.stream_tx_offset[stream_id] = off + len(data)
+        self._tx_total += len(data)
+
+    def _drain_blocked(self) -> None:
+        for sid in list(self._blocked_tx):
+            data, fin = self._blocked_tx.pop(sid)
+            self.send_stream(sid, data, fin=fin)
+
+    def _apply_peer_transport_params(self) -> None:
+        tp = P.decode_transport_params(self.tls.peer_transport_params
+                                       or b"")
+        # the peer's receive window for OUR data on client-initiated
+        # bidi streams: bidi_local from the client's view, bidi_remote
+        # from the server's offer
+        key = P.TP_MAX_STREAM_DATA_BIDI_LOCAL if not self.is_client \
+            else P.TP_MAX_STREAM_DATA_BIDI_REMOTE
+        if key in tp:
+            self.peer_max_stream_data = P.dec_varint(tp[key], 0)[0]
+        if P.TP_MAX_DATA in tp:
+            self.peer_max_data = P.dec_varint(tp[P.TP_MAX_DATA], 0)[0]
+
+    def _replenish_rx(self, sid: int, rs: _RecvStream,
+                      sp: "_Space") -> None:
+        """Top up the credit we advertised once half is consumed."""
+        if rs.delivered > rs.credit - STREAM_WINDOW // 2:
+            rs.credit = rs.delivered + STREAM_WINDOW
+            self._out_frames[2].append(
+                F.encode_max_stream_data(sid, rs.credit))
+            total = sum(r.delivered for r in self.streams_rx.values())
+            self._out_frames[2].append(
+                F.encode_max_data(total + CONN_WINDOW))
+
+    def close(self, error_code: int = 0, reason: str = "",
+              app: bool = False) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.close_reason = reason
+        level = 2 if 2 in self.keys_tx else (1 if 1 in self.keys_tx else 0)
+        frame = F.encode_close(error_code, reason, app=app)
+        self._send_datagram([(level, [frame])])
+        self._on_closed()
+
+    def _on_closed(self) -> None:
+        if self._pto_task is not None:
+            self._pto_task.cancel()
+            self._pto_task = None
+        if not self.handshake_done.done():
+            self.handshake_done.set_exception(
+                ConnectionError(f"quic closed: {self.close_reason}"))
+
+    def flush(self) -> None:
+        """Emit pending frames + due ACKs as coalesced datagrams."""
+        if self.closed:
+            return
+        sections = []
+        for level in (0, 1, 2):
+            if level not in self.keys_tx:
+                continue
+            frames = self._out_frames[level]
+            sp = self.spaces[level]
+            if sp.ack_due and sp.largest_rx >= 0:
+                frames = [self._ack_frame(sp)] + frames
+                sp.ack_due = False
+            if frames:
+                sections.append((level, frames))
+            self._out_frames[level] = []
+        if sections:
+            self._send_datagram(sections)
+
+    @staticmethod
+    def _ack_frame(sp: _Space) -> bytes:
+        # ranges from the (small) out-of-order residue + the floor prefix
+        ranges = []
+        pns = sorted(sp.rx_pns, reverse=True)
+        if pns:
+            hi = lo = pns[0]
+            for pn in pns[1:]:
+                if pn == lo - 1:
+                    lo = pn
+                else:
+                    ranges.append((lo, hi))
+                    hi = lo = pn
+            ranges.append((lo, hi))
+        if sp.rx_floor >= 0:
+            if ranges and ranges[-1][0] == sp.rx_floor + 1:
+                ranges[-1] = (0, ranges[-1][1])
+            else:
+                ranges.append((0, sp.rx_floor))
+        return F.encode_ack(sp.largest_rx, ranges)
+
+    def _send_datagram(self, sections: list[tuple[int, list[bytes]]]) -> None:
+        # split each level's frames into <=MTU packet payloads (frames are
+        # built <=~1010 bytes so boundaries always fit), then coalesce
+        # packets into datagrams under MAX_DATAGRAM
+        packets: list[tuple[int, bytes, bool]] = []
+        budget = MAX_DATAGRAM - 80          # header + tag headroom
+        for level, frames in sections:
+            cur = b""
+            eliciting = False
+            for fr in frames:
+                if cur and len(cur) + len(fr) > budget:
+                    packets.append((level, cur, eliciting))
+                    cur = b""
+                    eliciting = False
+                cur += fr
+                eliciting |= fr[0] not in (F.FT_PADDING, F.FT_ACK)
+            if cur:
+                packets.append((level, cur, eliciting))
+        out = b""
+        for level, payload, ack_eliciting in packets:
+            sp = self.spaces[level]
+            pn = sp.next_pn
+            sp.next_pn += 1
+            ptype = _PTYPE_OF_LVL[level]
+            if self.is_client and ptype == P.PT_INITIAL:
+                # client Initials must arrive in >=1200-byte datagrams
+                need = 1200 - len(out) - (len(payload) + 60)
+                if need > 0:
+                    payload += b"\x00" * need
+            raw = P.encode_packet(ptype, P.QUIC_V1, self.dcid, self.scid,
+                                  pn, payload, self.keys_tx[level])
+            if ack_eliciting:
+                sp.unacked[pn] = (time.monotonic(), payload, True)
+            if out and len(out) + len(raw) > MAX_DATAGRAM:
+                if self.transport is not None:
+                    self.transport.sendto(out, self.addr)
+                out = b""
+            out += raw
+        if out and self.transport is not None:
+            self.transport.sendto(out, self.addr)
+
+    # ---- PTO retransmit (handshake-critical data only) ----
+    def start_pto(self) -> None:
+        if self._pto_task is None:
+            self._pto_task = asyncio.ensure_future(self._pto_loop())
+
+    async def _pto_loop(self) -> None:
+        while not self.closed:
+            await asyncio.sleep(PTO_S)
+            now = time.monotonic()
+            # idle timeout (RFC 9000 §10.1: the advertised
+            # max_idle_timeout) — also reaps half-open handshakes, so a
+            # bare-Initial flood cannot pin connection slots forever
+            if now - self.last_rx > IDLE_TIMEOUT_S:
+                self.close(0, "idle timeout")
+                return
+            for level in (0, 1, 2):
+                sp = self.spaces[level]
+                if level not in self.keys_tx:
+                    continue
+                for pn, (ts, payload, eliciting) in list(sp.unacked.items()):
+                    if now - ts > PTO_S:
+                        del sp.unacked[pn]
+                        self._retransmit(level, payload, eliciting)
+
+    def _retransmit(self, level: int, payload: bytes,
+                    eliciting: bool) -> None:
+        """Re-send a lost payload under a NEW packet number, preserving
+        its ack-eliciting class (a payload that merely STARTS with an ACK
+        frame is still eliciting — classifying by first byte would stop
+        retransmitting a twice-lost handshake flight)."""
+        sp = self.spaces[level]
+        pn = sp.next_pn
+        sp.next_pn += 1
+        raw = P.encode_packet(_PTYPE_OF_LVL[level], P.QUIC_V1, self.dcid,
+                              self.scid, pn, payload, self.keys_tx[level])
+        if eliciting:
+            sp.unacked[pn] = (time.monotonic(), payload, True)
+        if self.transport is not None:
+            self.transport.sendto(raw, self.addr)
+
+    # ---- subclass hooks ----
+    def _after_tls_progress(self) -> None: ...
+
+    def _on_stream_frame(self, fr: F.Stream) -> None: ...
+
+    def _on_handshake_done_frame(self) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _QuicStreamWriter:
+    """StreamWriter-shaped adapter so broker Connection drives a QUIC
+    stream exactly like a TCP socket (the emqx_quic_stream analog)."""
+
+    class _Transport:
+        def __init__(self, outer):
+            self._outer = outer
+
+        def get_write_buffer_size(self) -> int:
+            return 0
+
+        def abort(self) -> None:
+            self._outer.close()
+
+    def __init__(self, conn: "QuicServerConnection", stream_id: int):
+        self._conn = conn
+        self._sid = stream_id
+        self._closing = False
+        self.transport = self._Transport(self)
+
+    def write(self, data: bytes) -> None:
+        if not self._closing and not self._conn.closed:
+            self._conn.send_stream(self._sid, data)
+            self._conn.flush()
+
+    async def drain(self) -> None:
+        pass
+
+    def is_closing(self) -> bool:
+        return self._closing or self._conn.closed
+
+    def close(self) -> None:
+        if not self._closing:
+            self._closing = True
+            if not self._conn.closed:
+                self._conn.send_stream(self._sid, b"", fin=True)
+                self._conn.flush()
+
+    async def wait_closed(self) -> None:
+        pass
+
+    def get_extra_info(self, name, default=None):
+        if name == "peername":
+            return self._conn.addr
+        if name == "sockname":
+            return self._conn.transport.get_extra_info("sockname", default)
+        return default
+
+
+class QuicServerConnection(QuicConnectionBase):
+    is_client = False
+
+    def __init__(self, listener: "QuicListener", transport, addr,
+                 odcid: bytes, client_scid: bytes):
+        super().__init__(transport, addr, scid=os.urandom(CID_LEN),
+                         dcid=client_scid)
+        self.listener = listener
+        self.odcid = odcid
+        tp = P.encode_transport_params({
+            P.TP_ORIGINAL_DCID: odcid,
+            P.TP_INITIAL_SCID: self.scid,
+            P.TP_MAX_IDLE_TIMEOUT: P.enc_varint(30000),
+            P.TP_MAX_UDP_PAYLOAD: P.enc_varint(MAX_DATAGRAM),
+            P.TP_MAX_DATA: P.enc_varint(CONN_WINDOW),
+            P.TP_MAX_STREAM_DATA_BIDI_LOCAL: P.enc_varint(STREAM_WINDOW),
+            P.TP_MAX_STREAM_DATA_BIDI_REMOTE: P.enc_varint(STREAM_WINDOW),
+            P.TP_MAX_STREAMS_BIDI: P.enc_varint(16),
+            P.TP_MAX_STREAMS_UNI: P.enc_varint(0),
+        })
+        self.tls = T.Tls13Server(listener.certfile, listener.keyfile,
+                                 ["mqtt"], tp)
+        self._setup_initial_keys(odcid)
+        self._done_sent = False
+        self._readers: dict[int, asyncio.StreamReader] = {}
+        self._conn_tasks: dict[int, asyncio.Task] = {}
+
+    def _after_tls_progress(self) -> None:
+        if self.tls.complete and not self._done_sent:
+            self._done_sent = True
+            self._out_frames[2].append(F.encode_handshake_done())
+            if not self.handshake_done.done():
+                self.handshake_done.set_result(True)
+
+    def _on_stream_frame(self, fr: F.Stream) -> None:
+        sid = fr.stream_id
+        if sid % 4 != 0:       # only client-initiated bidi carries MQTT
+            return
+        rs = self.streams_rx.get(sid)
+        if rs is None:
+            rs = self.streams_rx[sid] = _RecvStream()
+            reader = asyncio.StreamReader()
+            self._readers[sid] = reader
+            writer = _QuicStreamWriter(self, sid)
+            self._conn_tasks[sid] = asyncio.ensure_future(
+                self.listener._run_mqtt_connection(reader, writer))
+        data = rs.reassembly.feed(fr.offset, fr.data)
+        if fr.fin:
+            rs.fin_at = fr.offset + len(fr.data)
+        reader = self._readers[sid]
+        if data:
+            rs.delivered += len(data)
+            reader.feed_data(data)
+            self._replenish_rx(sid, rs, self.spaces[2])
+        if rs.fin_at is not None and rs.reassembly.next >= rs.fin_at:
+            reader.feed_eof()
+
+    def _on_closed(self) -> None:
+        super()._on_closed()
+        for reader in self._readers.values():
+            if not reader.at_eof():
+                reader.feed_eof()
+        self.listener._forget(self)
+
+
+class QuicListener:
+    """UDP endpoint accepting MQTT-over-QUIC connections
+    (emqx_listeners.erl quic listener analog)."""
+
+    protocol = "mqtt:quic"
+
+    def __init__(self, node, *, bind: str = "0.0.0.0", port: int = 14567,
+                 certfile: str, keyfile: str,
+                 zone: Optional[str] = None,
+                 max_connections: int = 1024000):
+        self.node = node
+        self.bind = bind
+        self.port = port
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.zone = zone
+        self.max_connections = max_connections
+        self.current_conns = 0
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        self._conns: dict[bytes, QuicServerConnection] = {}
+        self._mqtt_tasks: set[asyncio.Task] = set()
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        lst = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                lst._transport = transport
+
+            def datagram_received(self, data, addr):
+                lst._on_datagram(data, addr)
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(self.bind, self.port))
+        if self.port == 0:
+            self.port = self._transport.get_extra_info("sockname")[1]
+        log.info("quic listener started on %s:%d", self.bind, self.port)
+
+    async def stop(self) -> None:
+        for conn in list(self._conns.values()):
+            conn.close(0, "server shutdown")
+        for t in list(self._mqtt_tasks):
+            t.cancel()
+        if self._mqtt_tasks:
+            await asyncio.gather(*self._mqtt_tasks, return_exceptions=True)
+        if self._transport:
+            self._transport.close()
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        if len(data) < CID_LEN + 1:
+            return
+        try:
+            ptype, dcid, scid, _tok, _pn, _end = P.peek_header(
+                data, 0, CID_LEN)
+        except (IndexError, ValueError):
+            return
+        conn = self._conns.get(dcid)
+        if conn is None and ptype == P.PT_INITIAL:
+            if self.current_conns >= self.max_connections:
+                return
+            conn = QuicServerConnection(self, self._transport, addr,
+                                        odcid=dcid, client_scid=scid)
+            self.current_conns += 1
+            # route future packets by both the original DCID (more client
+            # Initials) and the server-chosen SCID (handshake/1-RTT)
+            self._conns[dcid] = conn
+            self._conns[conn.scid] = conn
+            conn.start_pto()
+        if conn is None:
+            return
+        conn.addr = addr
+        try:
+            conn.datagram_received(data)
+        except Exception:  # noqa: BLE001
+            log.exception("quic connection crashed")
+            conn.close(1, "internal error")
+
+    def _forget(self, conn: QuicServerConnection) -> None:
+        removed = False
+        for key in (conn.odcid, conn.scid):
+            if self._conns.pop(key, None) is not None:
+                removed = True
+        if removed:
+            self.current_conns -= 1
+
+    async def _run_mqtt_connection(self, reader, writer) -> None:
+        from emqx_tpu.broker.connection import Connection
+        conn = Connection(self.node, reader, writer, self.zone)
+        task = asyncio.current_task()
+        self._mqtt_tasks.add(task)
+        try:
+            await conn.run()
+        finally:
+            self._mqtt_tasks.discard(task)

@@ -343,7 +343,38 @@ FUNCS: dict[str, Any] = {
     "kv_store_put": lambda k, v: (_KV.__setitem__(_s(k), v), v)[1],
     "kv_store_del": lambda k: _KV.pop(_s(k), None) and None,
     "null": lambda: None,
+    # topic-filter membership (emqx_rule_funcs contains_topic/2,3 +
+    # contains_topic_match/2,3): first arg is a topic-filter array —
+    # either plain strings or {"topic": ..., "qos": ...} maps
+    "contains_topic": lambda fs, t, qos=None:
+        _find_topic_filter(fs, t, False, qos),
+    "contains_topic_match": lambda fs, t, qos=None:
+        _find_topic_filter(fs, t, True, qos),
 }
+
+# message-column accessor functions (emqx_rule_funcs qos/1, topic/1,
+# payload/1, clientid/1, username/1, clientip/peerhost/1, msgid/1,
+# flags/1, flag/2): zero-arg in SQL — the runtime resolves them from the
+# event columns in scope (see rules/runtime.py eval_expr 'call')
+COLUMN_FUNCS: dict[str, str] = {
+    "clientid": "clientid", "username": "username", "topic": "topic",
+    "payload": "payload", "qos": "qos", "clientip": "peerhost",
+    "peerhost": "peerhost", "msgid": "id", "flags": "flags",
+}
+
+
+def _find_topic_filter(filters, topic, wildcard: bool, qos=None) -> bool:
+    from emqx_tpu.utils import topic as T
+    t = _s(topic)
+    for f in filters or []:
+        if isinstance(f, dict):
+            filt, fqos = _s(f.get("topic")), f.get("qos")
+        else:
+            filt, fqos = _s(f), None
+        hit = T.match(t, filt) if wildcard else filt == t
+        if hit and (qos is None or fqos == qos):
+            return True
+    return False
 
 
 def _tz_seconds(tz) -> int:

@@ -47,7 +47,17 @@ def eval_expr(ast: Any, scopes: list[dict]) -> Any:
     if tag == "var":
         return _resolve_var(_eval_path(ast[1], scopes), scopes)
     if tag == "call":
-        return funcs.call(ast[1], [eval_expr(a, scopes) for a in ast[2]])
+        name = ast[1]
+        if not ast[2] and name in funcs.COLUMN_FUNCS:
+            # zero-arg column accessors: qos(), topic(), clientid(), ...
+            col = funcs.COLUMN_FUNCS[name]
+            if name == "flags":
+                return _resolve_var([col], scopes) or {}
+            return _resolve_var([col], scopes)
+        if name == "flag" and len(ast[2]) == 1:
+            fl = _resolve_var(["flags"], scopes) or {}
+            return bool(fl.get(funcs._s(eval_expr(ast[2][0], scopes))))
+        return funcs.call(name, [eval_expr(a, scopes) for a in ast[2]])
     if tag == "neg":
         return -eval_expr(ast[1], scopes)
     if tag == "not":

@@ -316,3 +316,52 @@ class TestEngine:
             'SELECT payload.x as x FROM "t/#" WHERE x = 1',
             {"topic": "t/1", "payload": '{"x": 1}'})
         assert out == [{"x": 1}]
+
+
+class TestColumnFuncsAndTopicContains:
+    """emqx_rule_funcs message-column accessors (qos/topic/clientid/...)
+    callable as zero-arg SQL functions, flag/1, and contains_topic[_match]."""
+
+    EVENT = {"topic": "t/1", "qos": 2, "clientid": "cid9",
+             "username": "u9", "peerhost": "10.0.0.7", "id": "MSG1",
+             "flags": {"retain": True, "dup": False},
+             "payload": "{}", "timestamp": 1700000000000}
+
+    def test_column_accessors(self):
+        [out] = sql_run(
+            'SELECT qos() as q, topic() as t, clientid() as c, '
+            'username() as u, clientip() as ip, msgid() as m, '
+            'flags() as fl, flag("retain") as r, flag("dup") as d '
+            'FROM "t/#"', self.EVENT)
+        assert out == {"q": 2, "t": "t/1", "c": "cid9", "u": "u9",
+                       "ip": "10.0.0.7", "m": "MSG1",
+                       "fl": {"retain": True, "dup": False},
+                       "r": True, "d": False}
+
+    def test_contains_topic(self):
+        from emqx_tpu.rules import funcs as F
+        filters = ["a/b", {"topic": "c/+", "qos": 1}]
+        assert F.call("contains_topic", [filters, "a/b"])
+        assert not F.call("contains_topic", [filters, "a/x"])
+        # exact membership, not wildcard match
+        assert not F.call("contains_topic", [filters, "c/z"])
+        assert F.call("contains_topic_match", [filters, "c/z"])
+        assert F.call("contains_topic_match", [filters, "c/z", 1])
+        assert not F.call("contains_topic_match", [filters, "c/z", 0])
+
+    def test_reference_export_coverage(self):
+        """Every function name exported by the reference's
+        emqx_rule_funcs.erl must be callable (by registry or as a
+        column accessor)."""
+        import re as _re
+
+        from emqx_tpu.rules import funcs as F
+        ref = open("/root/reference/apps/emqx_rule_engine/src/"
+                   "emqx_rule_funcs.erl").read()
+        names = set()
+        for block in _re.findall(r"^-export\(\[(.*?)\]\)", ref,
+                                 _re.S | _re.M):
+            names.update(_re.findall(r"([a-z_0-9]+)/\d", block))
+        covered = set(F.FUNCS) | set(F.COLUMN_FUNCS) | {"flag"}
+        missing = sorted(n for n in names if n not in covered)
+        assert not missing, f"uncovered reference funcs: {missing}"
