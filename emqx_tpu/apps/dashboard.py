@@ -101,6 +101,16 @@ def register_api(srv, node, admin: DashboardAdmin, mgmt=None) -> None:
     """Mount dashboard endpoints on a mgmt HttpServer."""
     from emqx_tpu.mgmt.httpd import ApiError
 
+    # the web UI itself + login are reachable without credentials (the
+    # page drives the token flow); everything else stays behind auth
+    srv.auth_exempt = tuple(
+        set(srv.auth_exempt) | {"/", "/dashboard", "/api/v5/login"})
+
+    async def index(_req):
+        return 200, (_ui_html(), "text/html; charset=utf-8")
+    srv.route("GET", "/", index)
+    srv.route("GET", "/dashboard", index)
+
     async def login(req):
         body = req.json() or {}
         tok = admin.sign_token(body.get("username", ""),
@@ -162,6 +172,9 @@ def register_api(srv, node, admin: DashboardAdmin, mgmt=None) -> None:
             "retained": stats.get("retained.count", 0),
             "received": node.metrics.val("messages.received"),
             "sent": node.metrics.val("messages.sent"),
+            # structured views the built-in UI renders
+            "stats": stats,
+            "metrics": node.metrics.all(),
         }
     srv.route("GET", "/api/v5/overview", overview)
 
@@ -169,3 +182,18 @@ def register_api(srv, node, admin: DashboardAdmin, mgmt=None) -> None:
 def _version() -> str:
     from emqx_tpu.version import __version__
     return __version__
+
+
+_UI_CACHE: Optional[bytes] = None
+
+
+def _ui_html() -> bytes:
+    """The single-file web UI (parity: the reference serves a prebuilt
+    dashboard bundle, scripts/get-dashboard.sh + emqx_dashboard)."""
+    global _UI_CACHE
+    if _UI_CACHE is None:
+        path = os.path.join(os.path.dirname(__file__), "assets",
+                            "dashboard.html")
+        with open(path, "rb") as f:
+            _UI_CACHE = f.read()
+    return _UI_CACHE

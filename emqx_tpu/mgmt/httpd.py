@@ -94,7 +94,11 @@ class HttpServer:
         return None, None
 
     def _authorized(self, path: str, headers: dict) -> bool:
-        if self.auth_check is None or path in self.auth_exempt:
+        # normalize like route matching does, so "/dashboard/" and "//"
+        # hit the same exemption as "/dashboard" and "/"
+        norm = "/" + "/".join(s for s in path.split("/") if s)
+        if self.auth_check is None or path in self.auth_exempt \
+                or norm in self.auth_exempt:
             return True
         hdr = headers.get("authorization", "")
         if hdr.lower().startswith("basic "):
@@ -147,11 +151,23 @@ class HttpServer:
                 query = dict(parse_qsl(url.query))
                 status, payload = await self._dispatch(
                     method.upper(), url.path, query, headers, body)
-                data = payload if isinstance(payload, (bytes, bytearray)) \
-                    else json.dumps(payload, default=_json_default).encode()
-                ctype = "application/octet-stream" \
-                    if isinstance(payload, (bytes, bytearray)) \
-                    else "application/json"
+                try:
+                    if isinstance(payload, tuple) and len(payload) == 2 \
+                            and isinstance(payload[0], (bytes, bytearray)) \
+                            and isinstance(payload[1], str):
+                        data, ctype = bytes(payload[0]), payload[1]
+                    elif isinstance(payload, (bytes, bytearray)):
+                        data, ctype = payload, "application/octet-stream"
+                    else:
+                        data = json.dumps(
+                            payload, default=_json_default).encode()
+                        ctype = "application/json"
+                except (TypeError, ValueError):
+                    # a handler returned something unserializable: the
+                    # client must still get a response, not a dead socket
+                    status = 500
+                    data = b'{"code":"INTERNAL_ERROR"}'
+                    ctype = "application/json"
                 writer.write(
                     f"HTTP/1.1 {status} {_reason(status)}\r\n"
                     f"content-type: {ctype}\r\n"

@@ -270,3 +270,36 @@ class TestTelemetry:
             assert ok and received[0]["uuid"] == tel.uuid
             await srv.stop()
         run(loop, go())
+
+    def test_dashboard_ui_served(self, loop):
+        """The built-in single-file web UI is served unauthenticated at /
+        and /dashboard (the login flow happens inside the page)."""
+        from emqx_tpu.mgmt.httpd import HttpServer
+        node = Node(use_device=False)
+        admin = DashboardAdmin(node)
+        srv = HttpServer("127.0.0.1", 0, auth_check=admin.auth_check,
+                         auth_exempt=("/api/v5/login",))
+        register_api(srv, node, admin)
+
+        async def fetch(path):
+            r, w = await asyncio.open_connection("127.0.0.1", srv.port)
+            w.write(f"GET {path} HTTP/1.1\r\nhost: x\r\n"
+                    f"connection: close\r\n\r\n".encode())
+            await w.drain()
+            raw = await r.read(-1)
+            w.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            return int(head.split()[1]), head.lower(), body
+
+        async def go():
+            await srv.start()
+            for path in ("/", "/dashboard"):
+                st, head, body = await fetch(path)
+                assert st == 200
+                assert b"text/html" in head
+                assert b"emqx-tpu dashboard" in body
+            # API stays protected
+            st, _, _ = await fetch("/api/v5/overview")
+            assert st == 401
+            await srv.stop()
+        run(loop, go())
