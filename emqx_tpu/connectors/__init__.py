@@ -11,3 +11,4 @@ from emqx_tpu.connectors.redis import RedisClient, RedisError  # noqa: F401
 from emqx_tpu.connectors.mysql import MysqlClient, MysqlError  # noqa: F401
 from emqx_tpu.connectors.pgsql import PgsqlClient, PgsqlError  # noqa: F401
 from emqx_tpu.connectors.mongo import MongoClient, MongoError  # noqa: F401
+from emqx_tpu.connectors.ldap import LdapClient, LdapError     # noqa: F401

@@ -53,7 +53,12 @@ class MongoClient:
             asyncio.open_connection(self.host, self.port, ssl=self.ssl),
             self.connect_timeout)
         if self.username:
-            await self._sasl_auth()
+            try:
+                await self._sasl_auth()
+            except BaseException:
+                self._w.close()  # auth failure must not leak the socket
+                self._r = self._w = None
+                raise
 
     async def _sasl_auth(self) -> None:
         mech = ("SCRAM-SHA-256" if self.auth_algo == "sha256"

@@ -122,6 +122,14 @@ class MysqlClient:
         self._r, self._w = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port, ssl=self.ssl),
             self.connect_timeout)
+        try:
+            await self._handshake()
+        except BaseException:
+            self._w.close()     # auth failure must not leak the socket
+            self._r = self._w = None
+            raise
+
+    async def _handshake(self) -> None:
         greet = await self._read_packet()
         if greet[:1] == b"\xff":
             raise self._err(greet)

@@ -38,13 +38,18 @@ class RedisClient:
         self._r, self._w = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port, ssl=self.ssl),
             self.connect_timeout)
-        if self.password:
-            if self.username:
-                await self.cmd(["AUTH", self.username, self.password])
-            else:
-                await self.cmd(["AUTH", self.password])
-        if self.database:
-            await self.cmd(["SELECT", str(self.database)])
+        try:
+            if self.password:
+                if self.username:
+                    await self.cmd(["AUTH", self.username, self.password])
+                else:
+                    await self.cmd(["AUTH", self.password])
+            if self.database:
+                await self.cmd(["SELECT", str(self.database)])
+        except BaseException:
+            self._w.close()         # auth failure must not leak the socket
+            self._r = self._w = None
+            raise
 
     async def close(self) -> None:
         if self._w is not None:

@@ -48,6 +48,45 @@ def put_sharded(mesh: Mesh, tables_stacked: RouterTables, cursors_stacked):
     return tables, cursors
 
 
+@functools.partial(jax.jit, donate_argnums=0)
+def _apply_shard_update(full, new, idx):
+    """Write one shard's tables into the stacked device arrays in place
+    (donated buffers; the traced index keeps ONE compilation for all
+    shards). Under a 'route' sharding XLA updates only the owning
+    device's slice — nothing else moves."""
+    return jax.tree.map(
+        lambda f, n: jax.lax.dynamic_update_index_in_dim(f, n, idx, 0),
+        full, new)
+
+
+def update_shard(tables_stacked, shard_idx: int, shard_tables):
+    """Incremental churn path (SURVEY §7 hard-part 1 under the mesh):
+    subscription changes in ONE filter shard rebuild that shard host-side
+    (same capacities as its siblings) and re-put ONLY its slice — the
+    round-1 story (rebuild one shard -> restack -> re-upload everything)
+    is gone.
+
+    tables_stacked: device pytree with leading 'route' axis (donated!).
+    shard_tables: the ONE shard's host pytree (no leading axis).
+    Returns the updated stacked pytree; the caller must adopt it (the
+    donated input is invalid afterwards).
+    """
+    n_shards = jax.tree.leaves(tables_stacked)[0].shape[0]
+    if not 0 <= shard_idx < n_shards:
+        # dynamic_update_index_in_dim would silently CLAMP and corrupt
+        # the edge shard
+        raise IndexError(f"shard_idx {shard_idx} out of range "
+                         f"[0, {n_shards})")
+    shapes_ok = jax.tree.map(
+        lambda f, n: f.shape[1:] == n.shape, tables_stacked, shard_tables)
+    if not all(jax.tree.leaves(shapes_ok)):
+        raise ValueError(
+            "shard tables shapes diverge from the stacked capacity "
+            "classes; rebuild every shard with matching capacities")
+    return _apply_shard_update(tables_stacked, shard_tables,
+                               jnp.int32(shard_idx))
+
+
 def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
                             frontier_cap: int = 16,
                             match_cap: int = 64, fanout_cap: int = 128,

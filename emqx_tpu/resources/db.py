@@ -12,8 +12,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from emqx_tpu.connectors import (ConnPool, MongoClient, MysqlClient,
-                                 PgsqlClient, RedisClient)
+from emqx_tpu.connectors import (ConnPool, LdapClient, MongoClient,
+                                 MysqlClient, PgsqlClient, RedisClient)
 from emqx_tpu.resources.resource import Resource, ResourceManager
 
 
@@ -133,6 +133,28 @@ class MongoResource(_PooledDbResource):
         raise ValueError(f"unknown mongo verb {verb!r}")
 
 
+class LdapResource(_PooledDbResource):
+    TYPE = "ldap"
+
+    def _make_client(self) -> LdapClient:
+        c = self.conf
+        return LdapClient(
+            host=c.get("host", "127.0.0.1"), port=c.get("port", 389),
+            bind_dn=c.get("bind_dn", ""),
+            bind_password=c.get("bind_password", ""), ssl=c.get("ssl"))
+
+    async def query(self, request: Any) -> Any:
+        """request: ("search", base_dn, scope, filter_bytes, [attrs])."""
+        if not (isinstance(request, (tuple, list)) and request
+                and request[0] == "search"):
+            raise ValueError(f"bad ldap request {request!r}")
+        _, base, scope, filt, *rest = request
+        attrs = rest[0] if rest else None
+        return await self.pool.run(
+            lambda c: c.search(base, scope, filt, attributes=attrs),
+            timeout=self.conf.get("timeout", 5))
+
+
 def _sql_request(request: Any) -> tuple[str, Optional[list]]:
     if isinstance(request, str):
         return request, None
@@ -142,5 +164,6 @@ def _sql_request(request: Any) -> tuple[str, Optional[list]]:
     raise ValueError(f"bad sql request {request!r}")
 
 
-for _cls in (RedisResource, MysqlResource, PgsqlResource, MongoResource):
+for _cls in (RedisResource, MysqlResource, PgsqlResource, MongoResource,
+             LdapResource):
     ResourceManager.register_type(_cls.TYPE, _cls)

@@ -433,3 +433,82 @@ class FakeMongo(_FakeServer):
                 doc.get("documents", []))
             return {"ok": 1.0, "n": len(doc.get("documents", []))}
         return {"ok": 0.0, "code": 59, "errmsg": "no such command"}
+
+
+class FakeLdap(_FakeServer):
+    """RFC 4511 subset: simple bind against a DN->password map, search
+    over a flat entry list with equality/present/AND filters."""
+
+    def __init__(self, binds: Optional[dict] = None,
+                 entries: Optional[list] = None):
+        super().__init__()
+        self.binds = binds if binds is not None else {"": ""}
+        self.entries = entries or []    # [{"dn": ..., attr: [vals]}]
+
+    async def session(self, reader, writer):
+        from emqx_tpu.connectors.ldap import (ber_int, ber_seq, ber_str,
+                                              read_int, read_tlv, tlv)
+        bound = False
+        while True:
+            head = await reader.readexactly(2)
+            ln = head[1]
+            if ln & 0x80:
+                ext = await reader.readexactly(ln & 0x7F)
+                ln = int.from_bytes(ext, "big")
+            body = await reader.readexactly(ln)
+            _t, mid_b, pos = read_tlv(body, 0)
+            mid = read_int(mid_b)
+            op_tag, op, _ = read_tlv(body, pos)
+
+            def send(tag, rbody):
+                writer.write(ber_seq(ber_int(mid), tlv(tag, rbody)))
+
+            if op_tag == 0x60:                               # bind
+                _t, _ver, p = read_tlv(op, 0)
+                _t, dn, p = read_tlv(op, p)
+                _t, pw, _ = read_tlv(op, p)
+                dn_s = dn.decode()
+                ok = dn_s in self.binds and \
+                    self.binds[dn_s] == pw.decode()
+                bound = ok
+                code = 0 if ok else 49                      # invalidCreds
+                send(0x61, ber_int(code, tag=0x0A) + ber_str("")
+                     + ber_str("" if ok else "invalid credentials"))
+            elif op_tag == 0x42:                             # unbind
+                return
+            elif op_tag == 0x63:                             # search
+                if not bound:
+                    send(0x65, ber_int(50, tag=0x0A) + ber_str("")
+                         + ber_str("not bound"))
+                else:
+                    _t, base, p = read_tlv(op, 0)
+                    for _ in range(5):                       # skip to filter
+                        _t, _x, p = read_tlv(op, p)
+                    ftag, fbody, p = read_tlv(op, p)
+                    for e in self.entries:
+                        if self._match(ftag, fbody, e):
+                            attrs = b"".join(
+                                ber_seq(ber_str(k), tlv(0x31, b"".join(
+                                    ber_str(v) for v in vs)))
+                                for k, vs in e.items() if k != "dn")
+                            send(0x64, ber_str(e["dn"]) + ber_seq(attrs))
+                    send(0x65, ber_int(0, tag=0x0A) + ber_str("")
+                         + ber_str(""))
+            await writer.drain()
+
+    def _match(self, ftag, fbody, entry) -> bool:
+        from emqx_tpu.connectors.ldap import read_tlv
+        if ftag == 0x87:                                     # present
+            return fbody.decode() in entry
+        if ftag == 0xA3:                                     # equality
+            _t, attr, p = read_tlv(fbody, 0)
+            _t, val, _ = read_tlv(fbody, p)
+            return val.decode() in entry.get(attr.decode(), [])
+        if ftag == 0xA0:                                     # AND
+            pos = 0
+            while pos < len(fbody):
+                t, b, pos = read_tlv(fbody, pos)
+                if not self._match(t, b, entry):
+                    return False
+            return True
+        return False

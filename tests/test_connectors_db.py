@@ -575,3 +575,66 @@ class TestEnhancedAuthEndToEnd:
             loop.run_until_complete(mgr.remove("mysql-e2e"))
             loop.run_until_complete(srv.stop())
             loop.run_until_complete(lst.stop())
+
+
+# ---------- LDAP ----------
+
+class TestLdap:
+    def test_bind_search(self, loop):
+        from emqx_tpu.connectors.ldap import (SCOPE_SUB, LdapClient,
+                                              LdapError, f_and, f_eq,
+                                              f_present)
+        from tests.fake_db import FakeLdap
+
+        async def go():
+            srv = await FakeLdap(
+                binds={"cn=admin,dc=x": "secret", "": ""},
+                entries=[
+                    {"dn": "uid=alice,ou=mqtt,dc=x",
+                     "uid": ["alice"], "userPassword": ["pw1"],
+                     "objectClass": ["mqttUser"]},
+                    {"dn": "uid=bob,ou=mqtt,dc=x",
+                     "uid": ["bob"], "objectClass": ["mqttUser"]},
+                ]).start()
+            c = LdapClient(port=srv.port, bind_dn="cn=admin,dc=x",
+                           bind_password="secret")
+            await c.connect()
+            rows = await c.search("ou=mqtt,dc=x", SCOPE_SUB,
+                                  f_eq("uid", "alice"))
+            assert len(rows) == 1
+            assert rows[0]["userPassword"] == ["pw1"]
+            rows = await c.search(
+                "ou=mqtt,dc=x", SCOPE_SUB,
+                f_and(f_present("objectClass"), f_eq("uid", "bob")))
+            assert [r["uid"] for r in rows] == [["bob"]]
+            assert await c.ping() is True
+            await c.close()
+
+            bad = LdapClient(port=srv.port, bind_dn="cn=admin,dc=x",
+                             bind_password="wrong")
+            with pytest.raises(LdapError) as ei:
+                await bad.connect()
+            assert ei.value.code == 49
+            await bad.close()
+            await srv.stop()
+        run(loop, go())
+
+    def test_ldap_resource(self, loop):
+        from emqx_tpu.connectors.ldap import SCOPE_SUB, f_eq
+        from tests.fake_db import FakeLdap
+
+        async def go():
+            node = Node(use_device=False)
+            mgr = ResourceManager(node)
+            srv = await FakeLdap(
+                entries=[{"dn": "uid=u,dc=x", "uid": ["u"],
+                          "objectClass": ["top"]}]).start()
+            res = await mgr.create("ld", "ldap", {"port": srv.port})
+            assert res.status == "connected"
+            rows = await res.query(("search", "dc=x", SCOPE_SUB,
+                                    f_eq("uid", "u")))
+            assert rows and rows[0]["dn"] == "uid=u,dc=x"
+            assert await res.health_check()
+            await mgr.remove("ld")
+            await srv.stop()
+        run(loop, go())

@@ -137,3 +137,53 @@ class TestShardedRouteStep:
         for i in range(8):
             got = [int(r) for r in np.asarray(res.rows[i]).ravel() if r >= 0]
             assert got == [i]
+
+    def test_incremental_shard_update(self):
+        """Churn in one filter shard re-puts ONLY that shard's slice:
+        routing reflects the new filters while other shards' results and
+        array shapes are untouched (SURVEY §7 hard-part 1 on the mesh)."""
+        from emqx_tpu.parallel.sharded import put_sharded, update_shard
+        mesh = make_mesh(8, dp=2, route=4)
+        intern = I.InternTable()
+        shard_filters = [["a/+"], ["b/+"], ["c/+"], ["d/+"]]
+        shards = []
+        for s, filts in enumerate(shard_filters):
+            normal = {i: [(s * 100 + i, 0)] for i in range(len(filts))}
+            shards.append(build_shard(filts, normal, {}, {}, intern,
+                                      filter_cap=4, node_cap=64,
+                                      slot_cap_n=2))
+        stacked = stack_tables(shards)
+        cursors = np.zeros((4, 2), np.int32)
+        tables_dev, cursors_dev = put_sharded(mesh, stacked, cursors)
+        step = make_sharded_route_step(mesh, frontier_cap=8, match_cap=16,
+                                       fanout_cap=16, slot_cap=4)
+
+        def route(tables, topics):
+            tw = [T.words(t) for t in topics]
+            enc, lens, dollar, _ = encode_topics(intern, tw, MAX_LEVELS)
+            res = step(tables, cursors_dev, enc, lens, dollar,
+                       np.zeros(len(topics), np.int32),
+                       np.int32(STRATEGY_ROUND_ROBIN))
+            return [sorted(int(r) for r in np.asarray(res.rows[b]).ravel()
+                           if r >= 0) for b in range(len(topics))]
+
+        topics = ["a/1", "b/1", "c/1", "d/1", "e/1"] * 2  # dp=2 needs even
+        before = route(tables_dev, topics)
+        assert before[:5] == [[0], [100], [200], [300], []]
+
+        # rebuild shard 2 with different filters (same capacities)
+        new2 = build_shard(["e/+", "c/x"],
+                           {0: [(777, 0)], 1: [(888, 0)]},
+                           {}, {}, intern, filter_cap=4, node_cap=64,
+                           slot_cap_n=2)
+        tables_dev = update_shard(tables_dev, 2, new2)
+        after = route(tables_dev, topics)
+        # shard 2's old filter is gone, its new ones live; others intact
+        assert after[:5] == [[0], [100], [], [300], [777]]
+
+        # capacity-class divergence is refused loudly
+        bad = build_shard(["x/+", "y/+", "z/+"], {0: [(1, 0)]}, {},
+                          {}, intern, filter_cap=16, node_cap=256,
+                          slot_cap_n=2)
+        with pytest.raises(ValueError):
+            update_shard(tables_dev, 1, bad)
