@@ -25,10 +25,16 @@ per-publisher-per-topic ordering is preserved.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Optional
 
 from emqx_tpu.broker.message import Message
+
+# re-probe the device path after this many consecutive host-routed
+# batches, so a transiently slow device (cold compile, relay hiccup)
+# is not written off forever
+_PROBE_EVERY = 64
 
 
 class PublishBatcher:
@@ -45,6 +51,14 @@ class PublishBatcher:
         self.max_pending = max_pending or 8 * max_batch
         self._queue: deque = deque()
         self._task: Optional[asyncio.Task] = None
+        # adaptive device/host choice: EWMAs of measured cost. On
+        # co-located hardware the fused device step wins from tiny
+        # batches; behind a high-latency dispatch relay the host path
+        # wins until batches amortize the round trip — measure, don't
+        # assume (SURVEY §7 hard-part 2's adaptive micro-batching).
+        self._dev_batch_s: Optional[float] = None    # per device batch
+        self._host_msg_s: Optional[float] = None     # per host message
+        self._since_probe = 0
 
     # ---- producer side --------------------------------------------------
     async def submit(self, msg: Message) -> int:
@@ -114,13 +128,45 @@ class PublishBatcher:
         if live:
             routed = None
             if (self.engine is not None
-                    and len(live) >= self.device_min_batch):
+                    and len(live) >= self.device_min_batch
+                    and self._device_worth_it(len(live))):
+                t0 = time.perf_counter()
                 routed = self.engine.route_batch(live)
+                if routed is not None:
+                    self._dev_batch_s = _ewma(
+                        self._dev_batch_s, time.perf_counter() - t0)
+                    self._since_probe = 0
             if routed is None:
+                t0 = time.perf_counter()
                 routed = [broker._route(m, broker.router.match(m.topic))
                           for m in live]
+                self._host_msg_s = _ewma(
+                    self._host_msg_s,
+                    (time.perf_counter() - t0) / len(live))
+                self._since_probe += 1
             for j, i in enumerate(live_idx):
                 counts[i] = routed[j]
         for i, (_m, fut) in enumerate(batch):
             if fut is not None and not fut.done():
                 fut.set_result(counts[i])
+
+    def _device_worth_it(self, n: int) -> bool:
+        """Measured-cost routing choice; optimistic until both EWMAs
+        exist, periodic re-probe so estimates track the environment."""
+        if self._dev_batch_s is None or self._host_msg_s is None:
+            return True
+        if self._since_probe >= _PROBE_EVERY:
+            return True
+        if self._dev_batch_s <= n * self._host_msg_s:
+            return True
+        self.node.metrics.inc("routing.device.bypassed")
+        return False
+
+
+def _ewma(cur: Optional[float], sample: float,
+          alpha: float = 0.2) -> float:
+    if cur is None:
+        return sample
+    # clamp wild outliers (a cold compile inside a sample) so one spike
+    # does not dominate the estimate
+    return (1 - alpha) * cur + alpha * min(sample, 5 * cur)

@@ -251,3 +251,41 @@ class TestEndToEnd:
             loop.run_until_complete(listener.stop())
         finally:
             loop.close()
+
+
+class TestAdaptiveDeviceChoice:
+    """SURVEY §7 hard-part 2: the batcher measures device-batch vs
+    host-per-message cost and routes each batch to the cheaper path,
+    re-probing the device periodically."""
+
+    def _batcher(self):
+        from emqx_tpu.broker.batcher import PublishBatcher
+        node = Node(use_device=False)
+        return PublishBatcher(node, None), node
+
+    def test_optimistic_until_measured(self):
+        b, _ = self._batcher()
+        assert b._device_worth_it(1)        # no data yet -> try device
+
+    def test_prefers_cheaper_path_and_reprobes(self):
+        from emqx_tpu.broker import batcher as BM
+        b, node = self._batcher()
+        b._dev_batch_s = 0.200              # relay-like: 200ms per batch
+        b._host_msg_s = 0.0001              # 10k msg/s host
+        assert not b._device_worth_it(64)   # 64 * 0.1ms << 200ms
+        assert node.metrics.val("routing.device.bypassed") == 1
+        assert b._device_worth_it(4000)     # big batch amortizes
+        # co-located-like: device far cheaper
+        b._dev_batch_s = 0.001
+        assert b._device_worth_it(64)
+        # forced re-probe after a long host streak
+        b._dev_batch_s = 10.0
+        b._since_probe = BM._PROBE_EVERY
+        assert b._device_worth_it(4)
+
+    def test_ewma_clamps_outliers(self):
+        from emqx_tpu.broker.batcher import _ewma
+        cur = 0.010
+        spiked = _ewma(cur, 30.0)           # cold-compile spike
+        assert spiked < 0.02                # clamped, not dominated
+        assert _ewma(None, 0.5) == 0.5
