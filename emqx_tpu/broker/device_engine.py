@@ -8,6 +8,14 @@ micro-batches, and consumes the `RouteResult` into actual session deliveries
 — replacing the reference's per-message publish path
 (emqx_broker.erl:199-308: match_routes → dispatch fold → shared pick).
 
+Serving is staged so the asyncio event loop never blocks on the device
+(round-2 weak #3): `prepare()` (loop: tokenize+encode), `dispatch()`
+(executor thread: the jitted step — on a dispatch relay this is the slow,
+blocking call), `materialize()` (executor thread: device→host readbacks),
+`finish()` (loop: consume RouteResult rows into session deliveries).
+`route_batch()` remains the synchronous composition for callers without a
+pipeline (publish_batch, tests, warmup).
+
 Snapshot/consistency model (SURVEY.md §7 hard-part 1, "mutable trie on
 immutable arrays"):
 
@@ -21,8 +29,12 @@ immutable arrays"):
   - a (filter, group) shared slot that changed is dirty likewise; a group
     added to a built filter is dispatched host-side until the next rebuild.
 - When accumulated churn crosses `rebuild_threshold` the snapshot is
-  recompiled (capacities padded to pow2 size classes so XLA recompiles only
-  on class growth, not on every rebuild).
+  recompiled **in the background, double-buffered** (round-2 weak #7): the
+  router/broker state is captured in cooperative chunks on the loop,
+  compiled + uploaded + warm-jitted off the loop, and swapped in atomically
+  once no dispatched batch is outstanding. Mutations during the build are
+  journaled and replayed against the new snapshot at swap, so no churn is
+  lost and serving never stalls on a rebuild.
 
 Delivery attribution: device fan-out rows for one message are the
 concatenation of per-filter CSR segments in match order, so the host walks
@@ -91,6 +103,25 @@ class _Built:
         self.backend = "trie"
 
 
+class _Handle:
+    """One in-flight dispatched batch (prepare → dispatch → materialize →
+    finish). Host-side metadata pins the snapshot the dispatch ran against;
+    the engine defers snapshot swaps until no handle is outstanding."""
+
+    __slots__ = ("msgs", "words_list", "too_long", "built", "dev_shared",
+                 "enc", "res", "np_res", "error")
+
+    def __init__(self, msgs, words_list, too_long, built, dev_shared):
+        self.msgs = msgs
+        self.words_list = words_list
+        self.too_long = too_long
+        self.built = built
+        self.dev_shared = dev_shared
+        self.res = None       # device RouteResult (set by dispatch)
+        self.np_res = None    # host numpy views (set by materialize)
+        self.error = None
+
+
 class DeviceRouteEngine:
     def __init__(self, node, *, rebuild_threshold: int = 256,
                  max_levels: int = 16, frontier_cap: int = 16,
@@ -121,6 +152,13 @@ class DeviceRouteEngine:
         self._delta_fid_of: dict[str, int] = {}
         self._next_delta_fid = 0
 
+        # background rebuild machinery (round-2 weak #7)
+        self._outstanding = 0          # dispatched-but-unfinished handles
+        self._journal: Optional[list] = None   # churn while a build runs
+        self._building = False
+        self._pending_swap = None      # (built, tables, cursors, rich)
+        self._rebuild_task = None
+
         # wire change notifications
         self.router.on_route_change = self.note_route_change
         self.broker.device_engine = self
@@ -138,6 +176,8 @@ class DeviceRouteEngine:
     def note_route_change(self, topic_filter: str, added: bool) -> None:
         """Router filter-universe change (local subscribe path and
         cluster-replicated remote routes both land here)."""
+        if self._journal is not None:
+            self._journal.append(("route", topic_filter, added))
         if self._built is None:
             return
         if added:
@@ -161,6 +201,8 @@ class DeviceRouteEngine:
 
     def note_member_change(self, real: str, group: Optional[str]) -> None:
         """Broker membership change (subscribe/unsubscribe/opts update)."""
+        if self._journal is not None:
+            self._journal.append(("member", real, group))
         if self._built is None:
             return
         if group is None:
@@ -175,7 +217,54 @@ class DeviceRouteEngine:
 
     # ---- snapshot compile ----------------------------------------------
     def rebuild(self) -> None:
-        """Compile router+broker state into fresh device tables and swap."""
+        """Compile router+broker state into fresh device tables and swap,
+        synchronously (first build / callers without a loop). The background
+        path is `maybe_background_rebuild`."""
+        capture = self._capture_state_sync()
+        result = self._build_from_capture(capture)
+        self._apply_build(result, journal=())
+
+    def _capture_state_sync(self):
+        """Point-in-time copy of the routing state (sync, may stall)."""
+        broker, router = self.broker, self.router
+        exact, wild = list(router.exact), list(router.wildcards)
+        filters = exact + wild
+        subs = {f: list(broker.subs[f].items())
+                for f in filters if broker.subs.get(f)}
+        shared = {f: {g: (list(grp.members.items()), grp.cursor)
+                      for g, grp in broker.shared[f].items()}
+                  for f in filters if broker.shared.get(f)}
+        return exact, wild, subs, shared
+
+    async def _capture_state_async(self, chunk: int = 1024):
+        """Chunked capture: yields to the loop between chunks so serving
+        continues; mutations landing mid-capture are journaled and replayed
+        at swap, so a half-captured filter at worst serves host-side.
+        (Sorting — O(n log n) over every filter string — happens on the
+        build thread, not here: list() of a set is a single atomic C call.)
+        """
+        import asyncio
+        broker, router = self.broker, self.router
+        exact, wild = list(router.exact), list(router.wildcards)
+        filters = exact + wild
+        subs: dict = {}
+        shared: dict = {}
+        for i in range(0, len(filters), chunk):
+            for f in filters[i:i + chunk]:
+                s = broker.subs.get(f)
+                if s:
+                    subs[f] = list(s.items())
+                g = broker.shared.get(f)
+                if g:
+                    shared[f] = {gn: (list(grp.members.items()), grp.cursor)
+                                 for gn, grp in g.items()}
+            await asyncio.sleep(0)
+        return exact, wild, subs, shared
+
+    def _build_from_capture(self, capture):
+        """Compile a captured state into device tables (loop-free: safe on
+        an executor thread). Returns (built, dev_tables, cursors_np, rich)
+        or None when the filter set is empty."""
         import jax
 
         from emqx_tpu.models.router_engine import (RouterTables,
@@ -184,14 +273,10 @@ class DeviceRouteEngine:
         from emqx_tpu.ops.shapes import ShapeCapacityError, build_shape_tables
         from emqx_tpu.ops.trie import build_tables
 
-        broker, router = self.broker, self.router
-        filters = sorted(router.exact) + sorted(router.wildcards)
+        exact, wild, subs_cap, shared_cap = capture
+        filters = sorted(exact) + sorted(wild)
         if not filters:
-            self._built = None
-            self._tables = None
-            self._cursors = None
-            self._reset_deltas()
-            return
+            return None
 
         b = _Built()
         b.fid_of = {f: i for i, f in enumerate(filters)}
@@ -212,28 +297,28 @@ class DeviceRouteEngine:
         rich: set[str] = set()
         seg_len = [0] * n
         for f, fid in b.fid_of.items():
-            subs = broker.subs.get(f)
+            subs = subs_cap.get(f)
             if subs:
                 entries = []
-                for sid, opts in subs.items():
+                for sid, opts in subs:
                     if _is_rich(opts):
                         rich.add(f)
                     entries.append((sid, _pack_opts(opts)))
                 normal[fid] = entries
                 seg_len[fid] = len(entries)
-            for g in sorted(broker.shared.get(f, {})):
-                grp = broker.shared[f][g]
+            for g in sorted(shared_cap.get(f, {})):
+                members_raw, cursor = shared_cap[f][g]
                 slot = len(b.slot_key)
                 b.slot_of[(f, g)] = slot
                 b.slot_key.append((f, g))
                 members = []
-                for sid, opts in grp.members.items():
+                for sid, opts in members_raw:
                     if _is_rich(opts):
                         rich.add(f)
                     members.append((sid, _pack_opts(opts)))
                 shared_members[slot] = members
                 filter_slots.setdefault(fid, []).append(slot)
-                cursors0.append(grp.cursor)
+                cursors0.append(cursor)
         b.seg_len = seg_len
         b.n_slots = len(b.slot_key)
 
@@ -266,11 +351,33 @@ class DeviceRouteEngine:
         cur = np.zeros(max(1, len(cursors0)), np.int32)
         if cursors0:
             cur[:len(cursors0)] = cursors0
-        self._tables = jax.device_put(tables)
-        self._cursors = jax.device_put(cur)
-        self._built = b
-        self.rich_filters = rich
+        dev_tables = jax.device_put(tables)
+        dev_cursors = jax.device_put(cur)
+        return b, dev_tables, dev_cursors, rich
+
+    def _apply_build(self, result, journal) -> None:
+        """Swap a finished build in and rebase churn tracking onto it by
+        replaying the journal of mutations that happened during the build."""
         self._reset_deltas()
+        if result is None:
+            self._built = None
+            self._tables = None
+            self._cursors = None
+        else:
+            b, tables, cursors, rich = result
+            self._built = b
+            self._tables = tables
+            self._cursors = cursors
+            self.rich_filters = rich
+        # replay churn that raced the build: journaled note_* calls are
+        # idempotent against the fresh snapshot (worst case marks a filter
+        # that the build already captured as dirty — correct, just host-side
+        # until the next rebuild)
+        for entry in journal:
+            if entry[0] == "route":
+                self.note_route_change(entry[1], entry[2])
+            else:
+                self.note_member_change(entry[1], entry[2])
         self.node.metrics.inc("routing.device.rebuilds")
 
     def _reset_deltas(self) -> None:
@@ -283,32 +390,150 @@ class DeviceRouteEngine:
         self._delta_fid_of = {}
         self._next_delta_fid = 0
 
+    # ---- background rebuild (double-buffered, round-2 weak #7) ----------
+    def poll_rebuild(self) -> None:
+        """The one rebuild policy, called on the batch cadence: a small
+        first build runs inline (milliseconds — the first batch already
+        rides the device); a big first build or a threshold crossing runs
+        double-buffered in the background."""
+        if self._building:
+            return
+        if self._built is None:
+            n = len(self.router.exact) + len(self.router.wildcards)
+            if n == 0:
+                return
+            if n <= 4096 or not self.maybe_background_rebuild():
+                self.rebuild()
+        elif self.staleness() >= self.rebuild_threshold:
+            self.maybe_background_rebuild()
+
+    def maybe_background_rebuild(self, executor=None) -> bool:
+        """Kick a background rebuild when churn crossed the threshold.
+        Returns True when one is running/queued after the call. Requires a
+        running loop; sync callers use rebuild()."""
+        import asyncio
+        if self._building:
+            return True
+        if self._built is not None \
+                and self.staleness() < self.rebuild_threshold:
+            return False
+        if self._built is None \
+                and not (self.router.exact or self.router.wildcards):
+            return False    # nothing to compile yet
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        self._building = True
+        self._journal = []
+        self._rebuild_task = loop.create_task(
+            self._background_rebuild(executor))
+        return True
+
+    async def _background_rebuild(self, executor=None) -> None:
+        import asyncio
+        loop = asyncio.get_running_loop()
+        try:
+            capture = await self._capture_state_async()
+            result = await loop.run_in_executor(
+                executor, self._build_from_capture, capture)
+            if result is not None:
+                await loop.run_in_executor(executor, self._warm_compile,
+                                           result)
+            self._pending_swap = (result,)   # 1-tuple: result may be None
+            self._try_swap()
+        except Exception:
+            import logging
+            logging.getLogger("emqx.device").exception(
+                "background snapshot rebuild failed; serving stays on the "
+                "old snapshot + host deltas")
+            self._journal = None
+            self._building = False
+            self._pending_swap = None
+            self.node.metrics.inc("routing.device.rebuild_failed")
+
+    def _warm_compile(self, result) -> None:
+        """Pre-jit the route step for the new tables' shapes across every
+        batch-size class, so neither the swap nor a later first-use of a
+        bigger batch class stalls serving on an XLA trace/compile (tracing
+        holds the GIL even on an executor thread; cached compiles don't)."""
+        import jax
+
+        from emqx_tpu.models.router_engine import (route_step,
+                                                   route_step_shapes)
+        from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+        b, tables, cursors, _rich = result
+        strat = np.int32(STRATEGY_ROUND_ROBIN)
+        for Bp in (64, 256, 1024):
+            enc = np.zeros((Bp, self.max_levels), np.int32)
+            lens = np.zeros(Bp, np.int32)
+            dollar = np.zeros(Bp, bool)
+            mh = np.zeros(Bp, np.int32)
+            if b.backend == "shapes":
+                r = route_step_shapes(tables, cursors, enc, lens, dollar,
+                                      mh, strat, fanout_cap=self.fanout_cap,
+                                      slot_cap=self.slot_cap)
+            else:
+                r = route_step(tables, cursors, enc, lens, dollar, mh,
+                               strat, frontier_cap=self.frontier_cap,
+                               match_cap=self.match_cap,
+                               fanout_cap=self.fanout_cap,
+                               slot_cap=self.slot_cap)
+            jax.block_until_ready(r.match_counts)
+
+    def _try_swap(self) -> None:
+        """Apply a finished background build if no dispatch is in flight
+        (handles pin the snapshot they were dispatched against)."""
+        if not self._building or self._pending_swap is None \
+                or self._outstanding > 0:
+            return
+        (result,) = self._pending_swap
+        journal = self._journal or ()
+        self._pending_swap = None
+        self._journal = None
+        self._building = False
+        self._apply_build(result, journal)
+
     # ---- the serving path ----------------------------------------------
     def device_shared_active(self) -> bool:
         from emqx_tpu.ops.shared import STRATEGIES
         return (self.broker.cluster is None
                 and self.broker.shared_strategy in STRATEGIES)
 
-    def route_batch(self, msgs: list[Message]) -> Optional[list[int]]:
-        """Route+deliver a micro-batch through the fused device step.
+    def prepare(self, msgs: list[Message]):
+        """Stage 1 (event loop): encode a micro-batch for dispatch.
 
-        Returns per-message delivery counts, or None when the engine has no
-        tables to serve (caller falls back to the host path).
+        Returns a _Handle, or None when the engine has no snapshot to serve
+        (caller routes host-side; a background rebuild may be warming up).
         """
-        if self._built is None or self.staleness() >= self.rebuild_threshold:
-            self.rebuild()
+        self.poll_rebuild()
         if self._built is None:
             return None
+        b = self._built
+        words_list = [T.tokens(m.topic) for m in msgs]
+        from emqx_tpu.ops.match import encode_topics
+        enc, lens, dollar, too_long = encode_topics(
+            self.intern, [w[:self.max_levels] for w in words_list],
+            self.max_levels)
+        h = _Handle(msgs, words_list, too_long, b,
+                    self.device_shared_active())
+        h.enc = (enc, lens, dollar)
+        self._outstanding += 1
+        return h
+
+    def dispatch(self, h) -> None:
+        """Stage 2 (executor thread): run the jitted route step. On a
+        dispatch relay this blocks on HTTP; on co-located hardware it is an
+        async enqueue — either way it is off the event loop."""
         from emqx_tpu.models.router_engine import (route_step,
                                                    route_step_shapes)
-        from emqx_tpu.ops.match import encode_topics
         from emqx_tpu.ops.shared import (STRATEGIES, STRATEGY_HASH_CLIENT,
                                          STRATEGY_HASH_TOPIC,
                                          STRATEGY_ROUND_ROBIN)
-
         broker = self.broker
-        b = self._built
+        msgs = h.msgs
         B = len(msgs)
+        enc, lens, dollar = h.enc
         # quantize the batch axis to few size classes — each class is one
         # XLA compile; without this every new pow2 batch size stalls live
         # traffic on a recompile
@@ -317,17 +542,11 @@ class DeviceRouteEngine:
                 break
         else:
             Bp = _next_pow2(B)
-        words_list = [T.tokens(m.topic) for m in msgs]
-        enc, lens, dollar, too_long = encode_topics(
-            self.intern, [w[:self.max_levels] for w in words_list],
-            self.max_levels)
         if Bp != B:
-            pad = ((0, Bp - B), (0, 0))
-            enc = np.pad(enc, pad, constant_values=I.PAD)
+            enc = np.pad(enc, ((0, Bp - B), (0, 0)), constant_values=I.PAD)
             lens = np.pad(lens, (0, Bp - B))
             dollar = np.pad(dollar, (0, Bp - B))
 
-        dev_shared = self.device_shared_active()
         strat_id = STRATEGIES.get(broker.shared_strategy,
                                   STRATEGY_ROUND_ROBIN)
         if strat_id == STRATEGY_HASH_TOPIC:
@@ -342,7 +561,7 @@ class DeviceRouteEngine:
         msg_hash = np.zeros(Bp, np.int32)
         msg_hash[:B] = mh
 
-        if b.backend == "shapes":
+        if h.built.backend == "shapes":
             res = route_step_shapes(
                 self._tables, self._cursors, enc, lens, dollar, msg_hash,
                 np.int32(strat_id), fanout_cap=self.fanout_cap,
@@ -354,37 +573,86 @@ class DeviceRouteEngine:
                 match_cap=self.match_cap, fanout_cap=self.fanout_cap,
                 slot_cap=self.slot_cap)
         self._cursors = res.new_cursors
+        h.res = res
 
-        matches = np.asarray(res.matches)
-        rows = np.asarray(res.rows)
-        opts = np.asarray(res.opts)
-        shared_sids = np.asarray(res.shared_sids)
-        shared_rows = np.asarray(res.shared_rows)
-        shared_opts = np.asarray(res.shared_opts)
-        overflow = np.asarray(res.overflow)
-        if dev_shared and b.n_slots:
-            self._writeback_cursors(np.asarray(res.occur))
+    def materialize(self, h) -> None:
+        """Stage 3 (executor thread): blocking device→host readbacks."""
+        res = h.res
+        h.np_res = (np.asarray(res.matches), np.asarray(res.rows),
+                    np.asarray(res.opts), np.asarray(res.shared_sids),
+                    np.asarray(res.shared_rows), np.asarray(res.shared_opts),
+                    np.asarray(res.overflow), np.asarray(res.occur))
 
-        metrics = self.node.metrics
-        counts: list[int] = []
-        for i, msg in enumerate(msgs):
-            if too_long[i] or overflow[i]:
-                metrics.inc("routing.device.host_fallback")
-                counts.append(broker._route(msg,
-                                            self.router.match(msg.topic)))
-                continue
-            counts.append(self._consume_one(
-                msg, matches[i], rows[i], opts[i], shared_sids[i],
-                shared_rows[i], shared_opts[i], words_list[i], dev_shared))
-        metrics.inc("routing.device.batches")
-        return counts
+    def finish(self, h) -> list[int]:
+        """Stage 4 (event loop): consume the RouteResult into deliveries."""
+        try:
+            (matches, rows, opts, shared_sids, shared_rows, shared_opts,
+             overflow, occur) = h.np_res
+            b = h.built
+            if h.dev_shared and b.n_slots:
+                self._writeback_cursors(occur, b)
+            metrics = self.node.metrics
+            counts: list[int] = []
+            broker = self.broker
+            for i, msg in enumerate(h.msgs):
+                if h.too_long[i] or overflow[i]:
+                    metrics.inc("routing.device.host_fallback")
+                    counts.append(broker._route(
+                        msg, self.router.match(msg.topic)))
+                    continue
+                counts.append(self._consume_one(
+                    msg, matches[i], rows[i], opts[i], shared_sids[i],
+                    shared_rows[i], shared_opts[i], h.words_list[i],
+                    h.dev_shared, b))
+            metrics.inc("routing.device.batches")
+            return counts
+        finally:
+            self.abandon(h)
 
-    def _writeback_cursors(self, occur: np.ndarray) -> None:
+    def abandon(self, h) -> None:
+        """Release a handle (also the error path: caller falls back to the
+        host route for the whole batch). Idempotent."""
+        if h is not None and h.built is not None:
+            h.built = None
+            self._outstanding -= 1
+            if self._building:
+                self._try_swap()
+
+    def route_batch(self, msgs: list[Message]) -> Optional[list[int]]:
+        """Route+deliver a micro-batch through the fused device step,
+        synchronously (publish_batch / tests / warmup). The pipelined
+        serving path drives the four stages separately via PublishBatcher.
+
+        Returns per-message delivery counts, or None when the engine has no
+        tables to serve (caller falls back to the host path).
+        """
+        # a sync rebuild must honor the handle pin: swapping _tables while
+        # the batcher has a dispatch in flight on the dispatch thread would
+        # hand that dispatch the new tables under the old _Built metadata
+        # (outstanding > 0 implies a snapshot exists, so serving stale +
+        # host deltas meanwhile is always correct)
+        if self._outstanding == 0 \
+                and (self._built is None
+                     or (not self._building
+                         and self.staleness() >= self.rebuild_threshold)):
+            self.rebuild()
+        h = self.prepare(msgs)
+        if h is None:
+            return None
+        try:
+            self.dispatch(h)
+            self.materialize(h)
+        except Exception:
+            self.abandon(h)
+            raise
+        return self.finish(h)
+
+    def _writeback_cursors(self, occur: np.ndarray, b=None) -> None:
         """Mirror device round-robin cursor advances into the host
         SharedGroup state so the host path and the next rebuild stay fair."""
         if self.broker.shared_strategy != "round_robin":
             return
-        b = self._built
+        b = b or self._built
         for slot in np.flatnonzero(occur[:b.n_slots]):
             f, gname = b.slot_key[slot]
             g = self.broker.shared.get(f, {}).get(gname)
@@ -392,11 +660,11 @@ class DeviceRouteEngine:
                 g.cursor = (g.cursor + int(occur[slot])) % len(g.members)
 
     def _consume_one(self, msg, m_row, r_row, o_row, ss_row, sr_row, so_row,
-                     words, dev_shared: bool) -> int:
+                     words, dev_shared: bool, b=None) -> int:
         """Turn one message's RouteResult rows into deliveries."""
         broker = self.broker
         metrics = self.node.metrics
-        b = self._built
+        b = b or self._built
         n = 0
         matched: list[str] = []
         off = 0
@@ -486,4 +754,6 @@ class DeviceRouteEngine:
             "churn": self.staleness(),
             "dirty_filters": len(self.dirty_filters),
             "delta_filters": len(self._delta_filter),
+            "building": self._building,
+            "outstanding": self._outstanding,
         }

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Per-stage profile of the fused route step on the real TPU.
+
+VERDICT round-2 weak #1: the fused step runs at 2.0M matches/s while the
+match fold alone does 9.3M/s — ~78% of the 65ms batch is somewhere in
+fan-out/shared/digest. This script times each stage in isolation using the
+same pipelined-window + digest-readback methodology as bench.py, so the
+numbers decompose the real batch cost instead of guessing.
+
+Usage: python tools/profile_step.py [subs] [batch] [window]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 131072
+    window = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import put_tree_chunked, _put_retry
+    from emqx_tpu.models.router_engine import (ShapeRouterTables,
+                                               route_step_shapes)
+    from emqx_tpu.ops import intern as I
+    from emqx_tpu.ops.fanout import (SubTable, fanout_normal, shared_slots)
+    from emqx_tpu.ops.shapes import build_shape_tables, shape_match
+    from emqx_tpu.ops.shared import (STRATEGY_ROUND_ROBIN, pick_members,
+                                     _rank_over_runs)
+
+    log(f"profile: subs={subs} B={B} window={window} dev={jax.devices()[0]}")
+
+    # same filter set as bench.py
+    ids = max(64, int(np.sqrt(subs)))
+    nums = max(1, subs // ids)
+    F = ids * nums
+    intern = I.InternTable()
+    wd = intern.intern("device")
+    id_ids = np.array([intern.intern(f"d{i}") for i in range(ids)], np.int32)
+    num_ids = np.array([intern.intern(f"n{n}") for n in range(nums)], np.int32)
+    rows = np.zeros((F, 8), np.int32)
+    lens = np.full(F, 5, np.int64)
+    rows[:, 0] = wd
+    rows[:, 1] = np.repeat(id_ids, nums)
+    rows[:, 2] = I.PLUS
+    rows[:, 3] = np.tile(num_ids, ids)
+    rows[:, 4] = I.HASH
+
+    t0 = time.time()
+    shapes = build_shape_tables(rows, lens)
+    log(f"build {time.time()-t0:.1f}s buckets={shapes.buckets.shape[0]}")
+
+    shared_pct = 50
+    n_shared_filters = F * shared_pct // 100
+    sub_start = np.arange(F + 1, dtype=np.int32)
+    sub_row = np.arange(F, dtype=np.int32)
+    sub_opts = np.ones(F, np.int32)
+    group_of = np.arange(n_shared_filters, dtype=np.int32) // 16
+    n_groups = max(1, int(group_of.max(initial=0)) + 1)
+    fs_start = np.zeros(F + 1, np.int32)
+    fs_start[1:n_shared_filters + 1] = 1
+    np.cumsum(fs_start, out=fs_start)
+    fs_slot = group_of if n_shared_filters else np.full(1, -1, np.int32)
+    shared_start = np.arange(n_groups + 1, dtype=np.int32) * 8
+    shared_row = F + np.arange(n_groups * 8, dtype=np.int32)
+    shared_opts_a = np.ones(n_groups * 8, np.int32)
+    subs_tbl = SubTable(sub_start, sub_row, sub_opts, fs_start, fs_slot,
+                        shared_start, shared_row, shared_opts_a)
+    tables = put_tree_chunked(ShapeRouterTables(shapes=shapes, subs=subs_tbl))
+    jax.block_until_ready(tables)
+    cursors0 = _put_retry(np.zeros(n_groups, np.int32))
+    strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
+
+    x = intern.intern("x")
+    tail = intern.intern("t")
+    rng = np.random.RandomState(7)
+    staged = []
+    for k in range(8):
+        zipf = np.minimum(rng.zipf(1.3, size=B) - 1, ids - 1)
+        tp = np.zeros((B, 8), np.int32)
+        tp[:, 0] = wd
+        tp[:, 1] = id_ids[zipf]
+        tp[:, 2] = x
+        tp[:, 3] = num_ids[rng.randint(0, nums, B)]
+        tp[:, 4] = tail
+        staged.append((_put_retry(tp),
+                       _put_retry(np.full(B, 5, np.int32)),
+                       _put_retry(np.zeros(B, bool)),
+                       _put_retry(rng.randint(0, 1 << 30, B)
+                                  .astype(np.int32))))
+
+    FAN_CAP = int(os.environ.get("BENCH_FANOUT_CAP", 4))
+    SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 2))
+
+    def timed(name, fn, *args_fn):
+        """Pipelined window of `fn(staged[i], ...)` closed by scalar read."""
+        def run(n):
+            acc = _put_retry(np.int32(0))
+            t0 = time.time()
+            for i in range(n):
+                acc = fn(acc, staged[i % 8])
+            _ = int(np.asarray(acc))
+            return time.time() - t0
+        run(2)  # warm/compile
+        dt = run(window)
+        per_ms = dt / window * 1000
+        log(f"{name:34s} {per_ms:8.2f} ms/batch   {B*window/dt/1e6:6.1f}M/s")
+        return per_ms
+
+    # 1. match only
+    @jax.jit
+    def f_match(acc, batch):
+        t, l, d, h = batch
+        r = shape_match(tables.shapes, t, l, d)
+        return acc + r.matches.sum(dtype=jnp.int32) + r.counts.sum()
+
+    # 2. match + fanout_normal
+    @jax.jit
+    def f_fan(acc, batch):
+        t, l, d, h = batch
+        r = shape_match(tables.shapes, t, l, d)
+        fr = fanout_normal(tables.subs, r.matches, fanout_cap=FAN_CAP)
+        return (acc + fr.rows.sum(dtype=jnp.int32) + fr.counts.sum()
+                + fr.opts.sum(dtype=jnp.int32))
+
+    # 3. match + shared_slots
+    @jax.jit
+    def f_slots(acc, batch):
+        t, l, d, h = batch
+        r = shape_match(tables.shapes, t, l, d)
+        sids, ov = shared_slots(tables.subs, r.matches, slot_cap=SLOT_CAP)
+        return acc + sids.sum(dtype=jnp.int32) + ov.sum()
+
+    # 4. match + slots + pick_members (full shared path)
+    @jax.jit
+    def f_shared(acc, batch):
+        t, l, d, h = batch
+        r = shape_match(tables.shapes, t, l, d)
+        sids, ov = shared_slots(tables.subs, r.matches, slot_cap=SLOT_CAP)
+        sp = pick_members(tables.subs, cursors0, sids, strat, h)
+        return (acc + sp.rows.sum(dtype=jnp.int32)
+                + sp.new_cursors.sum(dtype=jnp.int32))
+
+    # 4b. rank_over_runs alone (the argsort) on a [B, SLOT_CAP] input
+    @jax.jit
+    def f_rank(acc, batch):
+        t, l, d, h = batch
+        sids = jnp.stack([h % np.int32(n_groups),
+                          jnp.full((B,), -1, jnp.int32)], axis=1)
+        rank = _rank_over_runs(sids)
+        return acc + rank.sum(dtype=jnp.int32)
+
+    # 4c. occur scatter-add alone
+    @jax.jit
+    def f_occur(acc, batch):
+        t, l, d, h = batch
+        safe = (h % np.int32(n_groups)).astype(jnp.int32)
+        occur = jnp.zeros(n_groups, jnp.int32).at[safe].add(1, mode="drop")
+        return acc + occur.sum(dtype=jnp.int32)
+
+    # 5. full fused step + digest (= the bench step)
+    @jax.jit
+    def f_full(acc, batch):
+        t, l, d, h = batch
+        r = route_step_shapes(tables, cursors0, t, l, d, h, strat,
+                              fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
+        return (acc + r.rows.sum(dtype=jnp.int32)
+                + r.fan_counts.sum(dtype=jnp.int32)
+                + r.shared_rows.sum(dtype=jnp.int32)
+                + r.match_counts.sum(dtype=jnp.int32)
+                + r.opts.sum(dtype=jnp.int32))
+
+    timed("match only", f_match)
+    timed("match+fanout", f_fan)
+    timed("match+shared_slots", f_slots)
+    timed("match+slots+pick_members", f_shared)
+    timed("rank_over_runs (argsort) alone", f_rank)
+    timed("occur scatter-add alone", f_occur)
+    timed("FULL route_step + digest", f_full)
+
+
+if __name__ == "__main__":
+    main()
