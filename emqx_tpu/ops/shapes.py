@@ -94,6 +94,23 @@ def _homes(h1, h2, nb):
     return b1, b2
 
 
+def _homes_host(h1: np.ndarray, h2: np.ndarray, nb: int):
+    """_homes with in-place uint32 arithmetic (host build only; identical
+    results — the device/_fold_xla path keeps the functional version)."""
+    out = []
+    for a, b, c in ((h1, h2, 0x9E3779B1), (h2, h1, 0x85EBCA77)):
+        x = b * _U(c)
+        x ^= a
+        tmp = x >> _U(16)
+        x ^= tmp
+        x *= _U(0x7FEB352D)
+        np.right_shift(x, _U(13), out=tmp)
+        x ^= tmp
+        x &= _U(nb - 1)
+        out.append(x)
+    return out[0], out[1]
+
+
 def _place(home1: np.ndarray, home2: np.ndarray, nb: int):
     """Assign each item a (bucket, rank<BK) among its two homes, vectorized.
 
@@ -103,18 +120,37 @@ def _place(home1: np.ndarray, home2: np.ndarray, nb: int):
     round with shrinking rounds; a sequential cuckoo-eviction pass seats the
     tiny tail (~0.03% at 0.7 load). Returns (bucket, rank, leftover) —
     leftover is empty on success.
+
+    Round 0 (the whole array) is special-cased: the table is empty, so the
+    free-slot test and index compression are skipped — one scatter + one
+    winner re-gather instead of three random passes (the single-core build
+    budget at 10M filters is tight, round-2 weak #8).
     """
     F = len(home1)
-    h1_32 = home1.astype(np.int32)
-    h2_32 = home2.astype(np.int32)
+    h1_32 = np.ascontiguousarray(home1).view(np.int32) \
+        if home1.dtype == np.uint32 else home1.astype(np.int32)
+    h2_32 = np.ascontiguousarray(home2).view(np.int32) \
+        if home2.dtype == np.uint32 else home2.astype(np.int32)
     pos_tab = np.full(nb * BK, -1, np.int32)
-    pref = (h1_32 * 0x9E37 + h2_32 * 0x85EB)  # per-item probe-order seed
+    # round 0: everyone claims (b1, slot h2&7) in one fused expression —
+    # one random scatter + one random gather over the whole array; the
+    # slot bits come free from h2, no probe-seed pass needed yet
+    cand = (h1_32 << 3) | (h2_32 & (BK - 1))
     pending = np.arange(F, dtype=np.int32)
-    for r in range(2 * BK):  # one round per candidate position
+    pos_tab[cand] = pending              # all slots empty: claim directly
+    lost = pos_tab[cand] != pending
+    # carry compressed per-item arrays through the remaining rounds: the
+    # survivors shrink ~4x per round, and compressing beats re-gathering
+    # pref[pending]/h1[pending]/h2[pending] randomly each round
+    pending = pending[lost]
+    p1 = h1_32[lost]
+    p2 = h2_32[lost]
+    pref = p1 * 0x9E37 + p2 * 0x85EB     # per-item probe-order seed
+    for r in range(1, 2 * BK):           # one round per candidate position
         if len(pending) == 0:
             break
-        k = (pref[pending] + r) & (2 * BK - 1)
-        choice = np.where(k & 1 == 0, h1_32[pending], h2_32[pending])
+        k = (pref + r) & (2 * BK - 1)
+        choice = np.where(k & 1 == 0, p1, p2)
         cand = choice * BK + (k >> 1)
         free = pos_tab[cand] == -1
         cf, pf = cand[free], pending[free]
@@ -122,12 +158,15 @@ def _place(home1: np.ndarray, home2: np.ndarray, nb: int):
         lost = np.ones(len(pending), bool)
         lost[np.flatnonzero(free)[pos_tab[cf] == pf]] = False
         pending = pending[lost]
-    bucket = np.full(F, -1, np.int64)
-    rank = np.full(F, -1, np.int64)
-    filled = np.flatnonzero(pos_tab >= 0)
-    items = pos_tab[filled]
-    bucket[items] = filled // BK
-    rank[items] = filled % BK
+        p1, p2, pref = p1[lost], p2[lost], pref[lost]
+    # one merged random scatter of the flat position, then two sequential
+    # unpack passes (bucket = pos >> 3, rank = pos & 7 for BK == 8)
+    combined = np.full(F, -1, np.int32)
+    filled = np.flatnonzero(pos_tab >= 0).astype(np.int32)
+    combined[pos_tab[filled]] = filled
+    placed = combined >= 0
+    bucket = np.where(placed, combined >> 3, -1)
+    rank = np.where(placed, combined & 7, -1)
     if len(pending) == 0:
         return bucket, rank, pending
     return _place_evict(bucket, rank, pending, home1, home2,
@@ -168,16 +207,48 @@ def _place_evict(bucket, rank, pending, home1, home2, slots):
     return bucket, rank, np.array(still, np.int64)
 
 
-def _path_hashes(words: np.ndarray, slen, plus_mask, seeds1, seeds2):
-    """Fold concrete-word hashes over levels. words [N, L]; others [N]."""
-    h1, h2 = seeds1.copy(), seeds2.copy()
-    L = words.shape[1] if words.ndim == 2 else 0
+def _fold_into(h: np.ndarray, w: np.ndarray, l: int,
+               tmp: np.ndarray) -> None:
+    """In-place _fold (host only): identical uint32 arithmetic, no
+    intermediate allocations — the fold is memory-bound at 10M filters."""
+    np.multiply(w, _U(0x85EBCA77), out=tmp)
+    tmp += _U((l * 0x9E3779B1) & 0xFFFFFFFF)
+    h ^= tmp
+    h *= _U(0xC2B2AE35)
+    np.right_shift(h, _U(15), out=tmp)
+    h ^= tmp
+
+
+def _path_hashes(wordsT: np.ndarray, slen, plus_mask, seeds1, seeds2):
+    """Fold concrete-word hashes over levels. wordsT [L, N] (transposed so
+    each level is a contiguous row — the [N, L] column reads were paying
+    ~4x memory traffic at 10M filters); others [N].
+
+    Host-side fast paths (bit-identical to _fold/_fold_xla): levels where
+    no item is concrete are skipped, levels where every item is concrete
+    fold in place without the where-merge; the mixed case folds a copy and
+    merges masked.
+    """
+    h1 = np.asarray(seeds1).astype(np.uint32, copy=True)
+    h2 = np.asarray(seeds2).astype(np.uint32, copy=True)
+    N = len(h1)
+    L = wordsT.shape[0] if wordsT.ndim == 2 else 0
     L = min(L, int(np.max(slen, initial=0)))  # no concrete words beyond max slen
+    tmp = np.empty(N, np.uint32)
     for l in range(L):
         concrete = (l < slen) & ((plus_mask >> l) & 1 == 0)
-        w = words[:, l].astype("uint32")
-        h1 = np.where(concrete, _fold(h1, w, 2 * l), h1)
-        h2 = np.where(concrete, _fold(h2, w, 2 * l + 1), h2)
+        n_conc = int(np.count_nonzero(concrete))
+        if n_conc == 0:
+            continue
+        w = wordsT[l].view(np.uint32)
+        if n_conc == N:
+            _fold_into(h1, w, 2 * l, tmp)
+            _fold_into(h2, w, 2 * l + 1, tmp)
+        else:
+            for h, ll in ((h1, 2 * l), (h2, 2 * l + 1)):
+                folded = h.copy()
+                _fold_into(folded, w, ll, tmp)
+                np.copyto(h, folded, where=concrete)
     return h1, h2
 
 
@@ -212,19 +283,42 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
     L = words.shape[1]
     if L > 20:
         raise ValueError("shape tables support at most 20 levels")
-    arangeF = np.arange(F)
-    has_hash = (words[arangeF, lens - 1] == HASH).astype(np.int64)
-    slen = lens - has_hash
+    lens32 = lens.astype(np.int32)
+    arangeF = np.arange(F, dtype=np.int32)
+    has_hash = (words[arangeF, lens32 - 1] == HASH).astype(np.int32)
+    slen = lens32 - has_hash
+    # one transpose pass makes every level a contiguous row for the
+    # per-level loops here and in _path_hashes (column reads on [F, L]
+    # cost ~4x the memory traffic)
+    Lmax = min(L, int(slen.max(initial=0)))
+    wordsT = np.ascontiguousarray(words[:, :Lmax].T)
     # per-level accumulation: avoids materializing an [F, L] int64 temp
-    plus_mask = np.zeros(F, np.int64)
-    for l in range(min(L, int(slen.max(initial=0)))):
-        plus_mask |= ((words[:, l] == PLUS) & (l < slen)).astype(np.int64) << l
+    plus_mask = np.zeros(F, np.int32)
+    for l in range(Lmax):
+        plus_mask |= ((wordsT[l] == PLUS)
+                      & (l < slen)).astype(np.int32) << l
 
-    sig = plus_mask | (slen << 24) | (has_hash << 60)
-    uniq, inv = np.unique(sig, return_inverse=True)
-    NS = len(uniq)
+    # O(F) factorize via a 26-bit lookup table instead of np.unique's sort
+    # (plus_mask < 2^20 by the L<=20 guard, slen <= 20 -> 5 bits, has_hash
+    # 1 bit); flatnonzero keeps np.unique's sorted-uniq ordering, so shape
+    # ids are identical to the previous encoding
+    sig_small = plus_mask | (slen << 20) | (has_hash << 25)
+    seen = np.zeros(1 << 26, bool)
+    seen[sig_small] = True
+    uniq_small = np.flatnonzero(seen).astype(np.int64)
+    NS = len(uniq_small)
     if NS > shape_cap:
         raise ShapeCapacityError(f"{NS} shapes > cap {shape_cap}")
+    # a narrow lut (64MB int8 when NS fits) stays cache-friendlier than a
+    # 256MB int32 table for the 10M-gather that follows
+    lut_dtype = np.int8 if NS <= 127 else np.int32
+    lut = np.zeros(1 << 26, lut_dtype)
+    lut[uniq_small] = np.arange(NS, dtype=lut_dtype)
+    inv = lut[sig_small]
+    del seen, lut
+    # re-widen to the canonical sig encoding consumed below
+    uniq = ((uniq_small & 0xFFFFF) | (((uniq_small >> 20) & 0x1F) << 24)
+            | ((uniq_small >> 25) << 60))
     # pad the shape axis to the next pow2 of the ACTUAL count — every padded
     # shape costs a full [B]-wide bucket gather per match call
     NSc = 1 << max(0, (NS - 1).bit_length())
@@ -240,18 +334,18 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
                        ).astype(np.int32)
     shape_wild_root[shape_len < 0] = 0
 
-    sid = inv.astype(np.int64)
-    s1 = _seed(sid, 0x27D4EB2F, 0x165667B1)
-    s2 = _seed(sid, 0x85EBCA6B, 0xC2B2AE3D)
-    h1, h2 = _path_hashes(words, slen, plus_mask, s1, s2)
+    # seeds depend only on the shape id: hash NS values, gather by inv
+    sid_u = np.arange(NS, dtype=np.int64)
+    s1 = _seed(sid_u, 0x27D4EB2F, 0x165667B1)[inv]
+    s2 = _seed(sid_u, 0x85EBCA6B, 0xC2B2AE3D)[inv]
+    h1, h2 = _path_hashes(wordsT, slen, plus_mask, s1, s2)
 
     # pre-size to ~0.7 load: two-choice placement stays collision-free here,
     # so there is no grow-retry loop (round 1 spent 18s growing 16x)
     NB = bucket_capacity or _next_pow2(max(16, -(-F * 10 // (BK * 7))))
     while True:
-        b1, b2 = _homes(h1, h2, NB)
-        bucket, rank, leftover = _place(b1.astype(np.int64),
-                                        b2.astype(np.int64), NB)
+        b1, b2 = _homes_host(h1, h2, NB)
+        bucket, rank, leftover = _place(b1, b2, NB)
         if len(leftover) == 0:
             break
         if bucket_capacity is not None:
@@ -269,9 +363,14 @@ def build_shape_tables(words: np.ndarray, lens: np.ndarray,
 
     buckets = np.zeros((NB, 3 * BK), np.int32)
     buckets[:, 2 * BK:] = -1
-    buckets[bucket, rank] = h1.astype(np.int32)
-    buckets[bucket, BK + rank] = h2.astype(np.int32)
-    buckets[bucket, 2 * BK + rank] = filter_ids.astype(np.int32)
+    # one flat base index; three offset scatters (index math once, not 3x;
+    # an interleaved-row scatter + transpose was tried and lost cold — the
+    # extra 320MB of fresh pages cost more than the saved cache misses)
+    flat = buckets.reshape(-1)
+    base = bucket * (3 * BK) + rank      # NB*3*BK < 2^31: int32 safe
+    flat[base] = h1.view(np.int32)       # uint32 bit-reinterpret
+    flat[base + BK] = h2.view(np.int32)
+    flat[base + 2 * BK] = filter_ids.astype(np.int32)
 
     return ShapeTables(
         shape_plus_mask=shape_plus_mask, shape_len=shape_len,
