@@ -247,6 +247,122 @@ class ScramAuthenticator:
         return IGNORE, {}
 
 
+class LdapAuthenticator:
+    """LDAP bind authentication (round-2 VERDICT item 9): resolve the
+    client to a DN by a filter search, then attempt a simple bind with
+    the presented password — success authenticates. Parity: the
+    reference's eldap-backed authn (emqx_connector_ldap.erl providing the
+    transport; the search+bind flow is the classic LDAP auth pattern its
+    deployments use).
+
+    filter_tmpl supports `(attr=${placeholder})` and `(&(..)(..)...)`
+    with the same placeholder set as the SQL authenticators
+    (resolve_placeholder). Search runs on a service connection (bound as
+    `bind_dn` when given); the credential check binds on a FRESH
+    connection so the service bind is never downgraded.
+    """
+
+    name = "password_based:ldap"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 389,
+                 base_dn: str = "",
+                 filter_tmpl: str = "(uid=${mqtt-username})",
+                 bind_dn: Optional[str] = None, bind_password: str = "",
+                 superuser_attr: str = "isSuperuser", ssl=None,
+                 timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.base_dn = base_dn
+        self.filter_tmpl = filter_tmpl
+        self.bind_dn = bind_dn
+        self.bind_password = bind_password
+        self.superuser_attr = superuser_attr
+        self.ssl = ssl
+        self.timeout = timeout
+
+    def _client(self):
+        from emqx_tpu.connectors.ldap import LdapClient
+        return LdapClient(host=self.host, port=self.port, ssl=self.ssl,
+                          connect_timeout=self.timeout)
+
+    def _build_filter(self, clientinfo: dict,
+                      password: Optional[bytes]) -> Optional[bytes]:
+        from emqx_tpu.connectors import ldap as L
+
+        def build(expr: str) -> Optional[bytes]:
+            expr = expr.strip()
+            if not (expr.startswith("(") and expr.endswith(")")):
+                raise ValueError(f"bad LDAP filter {expr!r}")
+            inner = expr[1:-1]
+            if inner.startswith("&"):
+                parts, depth, start = [], 0, None
+                for i, ch in enumerate(inner):
+                    if ch == "(":
+                        if depth == 0:
+                            start = i
+                        depth += 1
+                    elif ch == ")":
+                        depth -= 1
+                        if depth == 0:
+                            parts.append(inner[start:i + 1])
+                subs = [build(p) for p in parts]
+                if any(s is None for s in subs):
+                    return None
+                return L.f_and(*subs)
+            attr, _, val = inner.partition("=")
+            m = re.fullmatch(r"\$\{([^}]+)\}", val.strip())
+            if m:
+                rv = resolve_placeholder(m.group(1), clientinfo, password)
+                if rv is None:
+                    return None
+                val = rv if isinstance(rv, str) else rv.decode()
+            return L.f_eq(attr.strip(), val)
+
+        return build(self.filter_tmpl)
+
+    async def authenticate_async(self, clientinfo: dict,
+                                 password: Optional[bytes]):
+        from emqx_tpu.connectors import ldap as L
+        if not password:
+            return IGNORE, {}
+        try:
+            filt = self._build_filter(clientinfo, password)
+        except ValueError:
+            return IGNORE, {}
+        if filt is None:
+            return IGNORE, {}
+        try:
+            svc = self._client()
+            await svc.connect()
+            try:
+                if self.bind_dn:
+                    await svc.bind(self.bind_dn, self.bind_password)
+                entries = await svc.search(
+                    self.base_dn, L.SCOPE_SUB, filt,
+                    attributes=[self.superuser_attr], size_limit=1)
+            finally:
+                await svc.close()
+        except Exception:  # noqa: BLE001 — unreachable/refused: next in chain
+            return IGNORE, {}
+        if not entries:
+            return IGNORE, {}
+        dn = entries[0]["dn"]
+        su_vals = entries[0].get(self.superuser_attr, [])
+        try:
+            cred = self._client()
+            await cred.connect()
+            try:
+                await cred.bind(dn, password.decode("utf-8", "replace"))
+            finally:
+                await cred.close()
+        except L.LdapError:
+            return DENY, {}
+        except Exception:  # noqa: BLE001
+            return IGNORE, {}
+        return OK, {"is_superuser": bool(su_vals)
+                    and _truthy(su_vals[0])}
+
+
 __all__ = ["MysqlAuthenticator", "PgsqlAuthenticator",
-           "MongoAuthenticator", "ScramAuthenticator", "ScramError",
-           "parse_query", "resolve_placeholder"]
+           "MongoAuthenticator", "ScramAuthenticator", "LdapAuthenticator",
+           "ScramError", "parse_query", "resolve_placeholder"]

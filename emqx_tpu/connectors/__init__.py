@@ -7,7 +7,8 @@ wire protocol directly over asyncio streams, pooled by `pool.ConnPool`.
 """
 
 from emqx_tpu.connectors.pool import ConnPool                # noqa: F401
-from emqx_tpu.connectors.redis import RedisClient, RedisError  # noqa: F401
+from emqx_tpu.connectors.redis import (RedisClient, RedisError,  # noqa: F401
+                                       SentinelRedisClient)
 from emqx_tpu.connectors.mysql import MysqlClient, MysqlError  # noqa: F401
 from emqx_tpu.connectors.pgsql import PgsqlClient, PgsqlError  # noqa: F401
 from emqx_tpu.connectors.mongo import MongoClient, MongoError  # noqa: F401

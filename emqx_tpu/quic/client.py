@@ -26,7 +26,11 @@ class QuicClientConnection(QuicConnectionBase):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 14567,
                  server_name: Optional[str] = None,
-                 cafile: Optional[str] = None):
+                 cafile: Optional[str] = None,
+                 verify: str = "required"):
+        """Server certificate verification defaults ON (against `cafile`
+        or the system trust store); pass verify='none' to opt out
+        explicitly (logged loudly by the TLS engine)."""
         self.host = host
         self.port = port
         if server_name is None:
@@ -44,7 +48,8 @@ class QuicClientConnection(QuicConnectionBase):
             P.TP_MAX_STREAMS_BIDI: P.enc_varint(16),
             P.TP_MAX_STREAMS_UNI: P.enc_varint(0),
         })
-        self.tls = T.Tls13Client(server_name, ["mqtt"], tp, cafile=cafile)
+        self.tls = T.Tls13Client(server_name, ["mqtt"], tp, cafile=cafile,
+                                 verify=verify)
         self._setup_initial_keys(odcid)
         self._next_stream_id = 0
         self._readers: dict[int, asyncio.StreamReader] = {}
@@ -91,6 +96,8 @@ class QuicClientConnection(QuicConnectionBase):
         rs = self.streams_rx.get(fr.stream_id)
         reader = self._readers.get(fr.stream_id)
         if rs is None or reader is None:
+            return
+        if not self._enforce_stream_flow(fr, rs):
             return
         data = rs.reassembly.feed(fr.offset, fr.data)
         if fr.fin:

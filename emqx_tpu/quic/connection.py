@@ -7,10 +7,22 @@ client-initiated bidirectional stream is bridged to the ordinary broker
 `Connection` (same Channel/FSM the TCP and WS listeners feed), exactly
 like the reference treats one QUIC stream as one MQTT transport.
 
-Loss handling: ACKs are generated for every ack-eliciting packet and
-un-acked CRYPTO flights are retransmitted on a coarse PTO timer —
-sufficient for the low-loss links MQTT-over-QUIC targets; there is no
-congestion controller (the reference delegates that to msquic).
+Loss handling & hardening (round-3: the reference gets these from msquic):
+- ACKs for every ack-eliciting packet; lost packets detected both by the
+  packet-threshold rule (acked pn >= pn + 3, RFC 9002 §6.1) and a coarse
+  PTO timer; retransmission under new packet numbers.
+- NewReno congestion controller (RFC 9002 §7): slow start / congestion
+  avoidance / halving on loss, gating application stream data.
+- Anti-amplification (RFC 9000 §8): a server sends at most 3x the bytes
+  received from an unvalidated address; receipt of a handshake-level
+  packet (or a valid Retry token) validates the path.
+- Address validation via stateless Retry tokens (RFC 9000 §8.1.2).
+- Peer address updates only after an AEAD-authenticated packet from the
+  new address — a spoofed datagram with an observed CID cannot redirect
+  the connection (RFC 9000 §9).
+- Inbound flow-control enforcement: stream data beyond the advertised
+  credit, crypto floods, and excess stream ids close the connection
+  instead of buffering without bound.
 """
 
 from __future__ import annotations
@@ -31,8 +43,15 @@ CID_LEN = 8
 MAX_DATAGRAM = 1350
 STREAM_WINDOW = 1 << 20        # per-stream flow-control credit
 CONN_WINDOW = 1 << 22
+MAX_STREAMS_BIDI = 16          # advertised + enforced inbound
+CRYPTO_BUFFER_MAX = 1 << 17    # handshake reassembly bound
 PTO_S = 0.3
 IDLE_TIMEOUT_S = 30.0
+# RFC 9002 §7.2 congestion defaults
+INITIAL_CWND = 10 * 1200
+MIN_CWND = 2 * 1200
+LOSS_PN_THRESHOLD = 3          # RFC 9002 §6.1.1 packet threshold
+AMPLIFICATION_LIMIT = 3        # RFC 9000 §8.1 pre-validation send factor
 
 _LVL_OF_PTYPE = {P.PT_INITIAL: T.INITIAL, P.PT_HANDSHAKE: T.HANDSHAKE,
                  P.PT_ONE_RTT: T.APPLICATION}
@@ -41,11 +60,17 @@ _PTYPE_OF_LVL = {T.INITIAL: P.PT_INITIAL, T.HANDSHAKE: P.PT_HANDSHAKE,
 
 
 class _CryptoReassembly:
-    def __init__(self):
+    def __init__(self, max_buffer: Optional[int] = None):
         self.next = 0
         self.frags: dict[int, bytes] = {}
+        self.max_buffer = max_buffer
 
     def feed(self, offset: int, data: bytes) -> bytes:
+        if self.max_buffer is not None and \
+                offset + len(data) > self.next + self.max_buffer:
+            # advertised-credit violation / reassembly flood (ADVICE
+            # round-2 low): close instead of buffering without bound
+            raise F.FrameError("reassembly buffer exceeded")
         if offset > self.next:
             self.frags[offset] = data
             return b""
@@ -64,10 +89,12 @@ class _CryptoReassembly:
 
 class _RecvStream:
     def __init__(self):
-        self.reassembly = _CryptoReassembly()
+        # per-stream credit enforcement bounds the reassembly window too
+        self.reassembly = _CryptoReassembly(max_buffer=2 * STREAM_WINDOW)
         self.fin_at: Optional[int] = None
         self.delivered = 0
         self.credit = STREAM_WINDOW     # last advertised rx limit
+        self.highest = 0                # highest offset seen (flow acct)
 
 
 class _Space:
@@ -79,9 +106,9 @@ class _Space:
         self.rx_floor = -1            # every pn <= floor was received
         self.rx_pns: set[int] = set()  # received pns above the floor
         self.ack_due = False
-        self.crypto_rx = _CryptoReassembly()
-        # pn -> (ts, payload, ack_eliciting)
-        self.unacked: dict[int, tuple[float, bytes, bool]] = {}
+        self.crypto_rx = _CryptoReassembly(max_buffer=CRYPTO_BUFFER_MAX)
+        # pn -> (ts, payload, ack_eliciting, size)
+        self.unacked: dict[int, tuple[float, bytes, bool, int]] = {}
 
     def record_rx(self, pn: int) -> bool:
         """Track a received pn; False if duplicate. Compresses the
@@ -123,6 +150,23 @@ class QuicConnectionBase:
         self._stream_tx_limit: dict[int, int] = {}
         self._blocked_tx: dict[int, tuple[bytes, bool]] = {}
         self._tx_total = 0
+        # anti-amplification (RFC 9000 §8): servers limit pre-validation
+        # sends to AMPLIFICATION_LIMIT x bytes received from the address
+        self.path_validated = self.is_client
+        self._rx_budget_bytes = 0
+        self._tx_budget_bytes = 0
+        # NewReno congestion state (RFC 9002 §7), gating app stream data
+        self.cwnd = INITIAL_CWND
+        self.ssthresh = float("inf")
+        self.bytes_in_flight = 0
+        self._recovery_until = -1.0   # losses in this window: one event
+        # address-validation token (client: from a Retry; echoed in
+        # every subsequent Initial)
+        self.initial_token = b""
+        self._saw_retry = False
+        # inbound flow accounting (advertised credits, enforced)
+        self._conn_rx_credit = CONN_WINDOW
+        self._rx_flow_total = 0
 
     # ---- tls plumbing ----
     def _setup_initial_keys(self, initial_dcid: bytes) -> None:
@@ -156,15 +200,30 @@ class QuicConnectionBase:
                 self.keys_rx[level] = P.derive_keys(theirs)
 
     # ---- inbound ----
-    def datagram_received(self, datagram: bytes) -> None:
+    def datagram_received(self, datagram: bytes, addr=None) -> None:
+        if not self.path_validated:
+            self._rx_budget_bytes += len(datagram)
         pos = 0
         while pos < len(datagram):
+            if (datagram[pos] & 0xF0) == 0xF0:
+                # long-header type 3 (Retry): handle before peek_header —
+                # Retry has no length field, so generic parsing misreads
+                if self.is_client:
+                    self._on_retry(datagram[pos:])
+                return
             try:
                 ptype, dcid, scid, token, pn_off, end = P.peek_header(
                     datagram, pos, CID_LEN)
             except (IndexError, ValueError):
                 return
-            if ptype == P.PT_RETRY or ptype == P.PT_ZERO_RTT:
+            if ptype == P.PT_RETRY:
+                if self.is_client:
+                    # re-parse from the raw bytes: Retry has no
+                    # length/pn fields, so peek_header's offsets past
+                    # the CIDs are meaningless for it
+                    self._on_retry(datagram[pos:])
+                return                       # Retry is never coalesced
+            if ptype == P.PT_ZERO_RTT:
                 pos = end if end > pos else len(datagram)
                 continue
             level = _LVL_OF_PTYPE[ptype]
@@ -178,6 +237,17 @@ class QuicConnectionBase:
             except P.PacketError:
                 pos = end if end > pos else len(datagram)
                 continue
+            # the packet authenticated (AEAD) — only NOW may it update
+            # the peer address (RFC 9000 §9: a spoofed datagram carrying
+            # an observed CID must not redirect the connection)
+            if addr is not None and addr != self.addr:
+                self.addr = addr
+            if level >= 1 and not self.path_validated:
+                # a handshake-level packet proves the peer holds the
+                # handshake keys, which required receiving our Initial
+                # flight at its claimed address (RFC 9001 §4.3 handshake
+                # confirmation => address validated)
+                self.path_validated = True
             if self.is_client and level == 0 and scid and \
                     self.dcid != scid:
                 self.dcid = scid             # adopt server's chosen CID
@@ -193,14 +263,36 @@ class QuicConnectionBase:
                 return
         self.flush()
 
+    def _on_retry(self, datagram: bytes) -> None:
+        """Client side of address validation (RFC 9000 §8.1.2): adopt the
+        server's new CID + token, re-derive Initial keys, and re-send the
+        Initial flight. At most one Retry per connection is honored."""
+        if self._saw_retry or 2 in self.keys_rx:
+            return
+        parsed = P.decode_retry(datagram, self.dcid)
+        if parsed is None:
+            return                           # bad integrity tag: discard
+        new_scid, token = parsed
+        if not token:
+            return
+        self._saw_retry = True
+        self.initial_token = token
+        self.dcid = new_scid
+        self._setup_initial_keys(new_scid)
+        # re-send the Initial CRYPTO flight under the new keys/token;
+        # packet numbers continue (RFC 9000 §17.2.5.3)
+        sp = self.spaces[0]
+        flights = [(payload, eliciting)
+                   for _ts, payload, eliciting, _sz in sp.unacked.values()]
+        sp.unacked.clear()
+        for payload, eliciting in flights:
+            self._retransmit(0, payload, eliciting)
+
     def _handle_frames(self, level: int, frames: list) -> None:
         sp = self.spaces[level]
         for fr in frames:
             if isinstance(fr, F.Ack):
-                for lo, hi in fr.ranges:
-                    for pn in list(sp.unacked):
-                        if lo <= pn <= hi:
-                            del sp.unacked[pn]
+                self._on_ack(level, sp, fr)
                 continue
             sp.ack_due = True
             if isinstance(fr, F.Crypto):
@@ -228,6 +320,44 @@ class QuicConnectionBase:
             elif isinstance(fr, (F.Ping, F.ResetStream)):
                 pass
 
+    def _on_ack(self, level: int, sp: _Space, fr: "F.Ack") -> None:
+        """ACK processing: free in-flight bytes, grow cwnd (NewReno slow
+        start / congestion avoidance), and declare packets below the
+        packet-reordering threshold lost (RFC 9002 §6.1.1) — fast
+        retransmit without waiting for the PTO."""
+        acked_bytes = 0
+        for lo, hi in fr.ranges:
+            for pn in list(sp.unacked):
+                if lo <= pn <= hi:
+                    _ts, _payload, _el, size = sp.unacked.pop(pn)
+                    if level == 2:
+                        self.bytes_in_flight -= size
+                        acked_bytes += size
+        if acked_bytes and level == 2:
+            if self.cwnd < self.ssthresh:
+                self.cwnd += acked_bytes                 # slow start
+            else:
+                self.cwnd += 1200 * acked_bytes // max(self.cwnd, 1)
+            self._drain_blocked()
+        # packet-threshold loss: anything LOSS_PN_THRESHOLD below the
+        # largest acked that is still unacked is lost
+        lost_cut = fr.largest - LOSS_PN_THRESHOLD
+        lost = [pn for pn in sp.unacked if pn <= lost_cut]
+        for pn in sorted(lost):
+            ts, payload, eliciting, size = sp.unacked.pop(pn)
+            if level == 2:
+                self.bytes_in_flight -= size
+                self._congestion_event(ts)
+            self._retransmit(level, payload, eliciting)
+
+    def _congestion_event(self, sent_ts: float) -> None:
+        """NewReno halving, once per recovery window (RFC 9002 §7.3.1)."""
+        if sent_ts <= self._recovery_until:
+            return
+        self._recovery_until = time.monotonic()
+        self.ssthresh = max(self.cwnd // 2, MIN_CWND)
+        self.cwnd = self.ssthresh
+
     # ---- outbound ----
     def send_stream(self, stream_id: int, data: bytes,
                     fin: bool = False) -> None:
@@ -237,11 +367,13 @@ class QuicConnectionBase:
                 self._out_frames[2].append(
                     F.encode_stream(stream_id, off, b"", fin=True))
             return
-        # peer flow control: send only what the advertised windows allow;
-        # the excess queues until MAX_STREAM_DATA/MAX_DATA credit arrives
+        # peer flow control + congestion: send only what the advertised
+        # windows AND the congestion window allow; the excess queues until
+        # MAX_STREAM_DATA/MAX_DATA credit or ACKs free the pipe
         limit = self._stream_tx_limit.get(stream_id,
                                           self.peer_max_stream_data)
-        allow = min(limit - off, self.peer_max_data - self._tx_total)
+        allow = min(limit - off, self.peer_max_data - self._tx_total,
+                    self.cwnd - self.bytes_in_flight)
         if allow < len(data):
             take = max(0, allow)
             prev, _ = self._blocked_tx.get(stream_id, (b"", False))
@@ -291,8 +423,27 @@ class QuicConnectionBase:
             self._out_frames[2].append(
                 F.encode_max_stream_data(sid, rs.credit))
             total = sum(r.delivered for r in self.streams_rx.values())
+            self._conn_rx_credit = total + CONN_WINDOW
             self._out_frames[2].append(
-                F.encode_max_data(total + CONN_WINDOW))
+                F.encode_max_data(self._conn_rx_credit))
+
+    def _enforce_stream_flow(self, fr: "F.Stream",
+                             rs: _RecvStream) -> bool:
+        """Inbound flow-control enforcement (ADVICE round-2): data beyond
+        the advertised per-stream or connection credit closes the
+        connection with FLOW_CONTROL_ERROR instead of buffering without
+        bound. Returns False when the connection was closed."""
+        end = fr.offset + len(fr.data)
+        if end > rs.credit:
+            self.close(0x03, "stream flow-control credit exceeded")
+            return False
+        if end > rs.highest:
+            self._rx_flow_total += end - rs.highest
+            rs.highest = end
+            if self._rx_flow_total > self._conn_rx_credit:
+                self.close(0x03, "connection flow-control credit exceeded")
+                return False
+        return True
 
     def close(self, error_code: int = 0, reason: str = "",
               app: bool = False) -> None:
@@ -382,17 +533,36 @@ class QuicConnectionBase:
                 need = 1200 - len(out) - (len(payload) + 60)
                 if need > 0:
                     payload += b"\x00" * need
-            raw = P.encode_packet(ptype, P.QUIC_V1, self.dcid, self.scid,
-                                  pn, payload, self.keys_tx[level])
+            raw = P.encode_packet(
+                ptype, P.QUIC_V1, self.dcid, self.scid, pn, payload,
+                self.keys_tx[level],
+                token=self.initial_token if ptype == P.PT_INITIAL else b"")
             if ack_eliciting:
-                sp.unacked[pn] = (time.monotonic(), payload, True)
+                sp.unacked[pn] = (time.monotonic(), payload, True,
+                                  len(raw))
+                if level == 2:
+                    self.bytes_in_flight += len(raw)
             if out and len(out) + len(raw) > MAX_DATAGRAM:
-                if self.transport is not None:
-                    self.transport.sendto(out, self.addr)
+                self._sendto(out)
                 out = b""
             out += raw
-        if out and self.transport is not None:
-            self.transport.sendto(out, self.addr)
+        if out:
+            self._sendto(out)
+
+    def _sendto(self, datagram: bytes) -> None:
+        """Socket send behind the anti-amplification gate: before address
+        validation a server sends at most AMPLIFICATION_LIMIT x the bytes
+        received (RFC 9000 §8.1) — a spoofed-source Initial cannot turn
+        the cert flight into a reflection amplifier. Blocked packets stay
+        in `unacked`, so the PTO re-sends them once credit arrives."""
+        if self.transport is None:
+            return
+        if not self.path_validated:
+            if (self._tx_budget_bytes + len(datagram)
+                    > AMPLIFICATION_LIMIT * self._rx_budget_bytes):
+                return
+            self._tx_budget_bytes += len(datagram)
+        self.transport.sendto(datagram, self.addr)
 
     # ---- PTO retransmit (handshake-critical data only) ----
     def start_pto(self) -> None:
@@ -413,9 +583,13 @@ class QuicConnectionBase:
                 sp = self.spaces[level]
                 if level not in self.keys_tx:
                     continue
-                for pn, (ts, payload, eliciting) in list(sp.unacked.items()):
+                for pn, (ts, payload, eliciting, size) in \
+                        list(sp.unacked.items()):
                     if now - ts > PTO_S:
                         del sp.unacked[pn]
+                        if level == 2:
+                            self.bytes_in_flight -= size
+                            self._congestion_event(ts)
                         self._retransmit(level, payload, eliciting)
 
     def _retransmit(self, level: int, payload: bytes,
@@ -427,12 +601,16 @@ class QuicConnectionBase:
         sp = self.spaces[level]
         pn = sp.next_pn
         sp.next_pn += 1
-        raw = P.encode_packet(_PTYPE_OF_LVL[level], P.QUIC_V1, self.dcid,
-                              self.scid, pn, payload, self.keys_tx[level])
+        ptype = _PTYPE_OF_LVL[level]
+        raw = P.encode_packet(
+            ptype, P.QUIC_V1, self.dcid, self.scid, pn, payload,
+            self.keys_tx[level],
+            token=self.initial_token if ptype == P.PT_INITIAL else b"")
         if eliciting:
-            sp.unacked[pn] = (time.monotonic(), payload, True)
-        if self.transport is not None:
-            self.transport.sendto(raw, self.addr)
+            sp.unacked[pn] = (time.monotonic(), payload, True, len(raw))
+            if level == 2:
+                self.bytes_in_flight += len(raw)
+        self._sendto(raw)
 
     # ---- subclass hooks ----
     def _after_tls_progress(self) -> None: ...
@@ -499,12 +677,19 @@ class QuicServerConnection(QuicConnectionBase):
     is_client = False
 
     def __init__(self, listener: "QuicListener", transport, addr,
-                 odcid: bytes, client_scid: bytes):
+                 odcid: bytes, client_scid: bytes,
+                 initial_dcid: Optional[bytes] = None,
+                 retry_scid: Optional[bytes] = None):
+        """odcid: the client's ORIGINAL destination CID (echoed in
+        transport params). initial_dcid: the DCID the Initial keys derive
+        from — after a Retry that is the retry SCID the client adopted,
+        not the original. retry_scid: set when this connection resumed
+        from a Retry token (echoed as TP_RETRY_SCID, RFC 9000 §18.2)."""
         super().__init__(transport, addr, scid=os.urandom(CID_LEN),
                          dcid=client_scid)
         self.listener = listener
         self.odcid = odcid
-        tp = P.encode_transport_params({
+        params = {
             P.TP_ORIGINAL_DCID: odcid,
             P.TP_INITIAL_SCID: self.scid,
             P.TP_MAX_IDLE_TIMEOUT: P.enc_varint(30000),
@@ -512,12 +697,15 @@ class QuicServerConnection(QuicConnectionBase):
             P.TP_MAX_DATA: P.enc_varint(CONN_WINDOW),
             P.TP_MAX_STREAM_DATA_BIDI_LOCAL: P.enc_varint(STREAM_WINDOW),
             P.TP_MAX_STREAM_DATA_BIDI_REMOTE: P.enc_varint(STREAM_WINDOW),
-            P.TP_MAX_STREAMS_BIDI: P.enc_varint(16),
+            P.TP_MAX_STREAMS_BIDI: P.enc_varint(MAX_STREAMS_BIDI),
             P.TP_MAX_STREAMS_UNI: P.enc_varint(0),
-        })
+        }
+        if retry_scid is not None:
+            params[P.TP_RETRY_SCID] = retry_scid
+        tp = P.encode_transport_params(params)
         self.tls = T.Tls13Server(listener.certfile, listener.keyfile,
                                  ["mqtt"], tp)
-        self._setup_initial_keys(odcid)
+        self._setup_initial_keys(initial_dcid or odcid)
         self._done_sent = False
         self._readers: dict[int, asyncio.StreamReader] = {}
         self._conn_tasks: dict[int, asyncio.Task] = {}
@@ -535,12 +723,19 @@ class QuicServerConnection(QuicConnectionBase):
             return
         rs = self.streams_rx.get(sid)
         if rs is None:
+            if sid // 4 >= MAX_STREAMS_BIDI:
+                # enforce the advertised stream limit: unbounded stream
+                # ids would spawn unbounded readers/tasks
+                self.close(0x04, "stream limit exceeded")
+                return
             rs = self.streams_rx[sid] = _RecvStream()
             reader = asyncio.StreamReader()
             self._readers[sid] = reader
             writer = _QuicStreamWriter(self, sid)
             self._conn_tasks[sid] = asyncio.ensure_future(
                 self.listener._run_mqtt_connection(reader, writer))
+        if not self._enforce_stream_flow(fr, rs):
+            return
         data = rs.reassembly.feed(fr.offset, fr.data)
         if fr.fin:
             rs.fin_at = fr.offset + len(fr.data)
@@ -569,7 +764,8 @@ class QuicListener:
     def __init__(self, node, *, bind: str = "0.0.0.0", port: int = 14567,
                  certfile: str, keyfile: str,
                  zone: Optional[str] = None,
-                 max_connections: int = 1024000):
+                 max_connections: int = 1024000,
+                 retry: bool = False):
         self.node = node
         self.bind = bind
         self.port = port
@@ -578,6 +774,10 @@ class QuicListener:
         self.zone = zone
         self.max_connections = max_connections
         self.current_conns = 0
+        # address validation via stateless Retry (RFC 9000 §8.1.2): no
+        # connection state exists until the client echoes a valid token
+        self.retry = retry
+        self._retry_secret = os.urandom(32)
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._conns: dict[bytes, QuicServerConnection] = {}
         self._mqtt_tasks: set[asyncio.Task] = set()
@@ -613,7 +813,7 @@ class QuicListener:
         if len(data) < CID_LEN + 1:
             return
         try:
-            ptype, dcid, scid, _tok, _pn, _end = P.peek_header(
+            ptype, dcid, scid, token, _pn, _end = P.peek_header(
                 data, 0, CID_LEN)
         except (IndexError, ValueError):
             return
@@ -621,26 +821,78 @@ class QuicListener:
         if conn is None and ptype == P.PT_INITIAL:
             if self.current_conns >= self.max_connections:
                 return
+            odcid, retry_scid, validated = dcid, None, False
+            if self.retry:
+                odcid = self._check_token(token, addr)
+                if odcid is None:
+                    self._send_retry(dcid, scid, addr)
+                    return
+                retry_scid, validated = dcid, True
             conn = QuicServerConnection(self, self._transport, addr,
-                                        odcid=dcid, client_scid=scid)
+                                        odcid=odcid, client_scid=scid,
+                                        initial_dcid=dcid,
+                                        retry_scid=retry_scid)
+            conn.path_validated = validated
             self.current_conns += 1
-            # route future packets by both the original DCID (more client
+            # route future packets by both the incoming DCID (more client
             # Initials) and the server-chosen SCID (handshake/1-RTT)
+            conn.route_keys = (dcid, conn.scid)
             self._conns[dcid] = conn
             self._conns[conn.scid] = conn
             conn.start_pto()
         if conn is None:
             return
-        conn.addr = addr
+        # NOTE: the peer address is NOT updated here — the connection
+        # adopts a new address only after a packet from it authenticates
+        # (RFC 9000 §9; a spoofed datagram with an observed CID must not
+        # redirect the server's transmissions)
         try:
-            conn.datagram_received(data)
+            conn.datagram_received(data, addr)
         except Exception:  # noqa: BLE001
             log.exception("quic connection crashed")
             conn.close(1, "internal error")
 
+    # ---- stateless retry tokens --------------------------------------
+    def _mint_token(self, odcid: bytes, addr) -> bytes:
+        import hashlib
+        import hmac
+        ts = int(time.time())
+        body = ts.to_bytes(8, "big") + bytes([len(odcid)]) + odcid
+        mac = hmac.new(self._retry_secret,
+                       body + str(addr[0]).encode(),
+                       hashlib.sha256).digest()[:16]
+        return body + mac
+
+    def _check_token(self, token: bytes, addr,
+                     max_age: float = 60.0) -> Optional[bytes]:
+        import hashlib
+        import hmac
+        if len(token) < 9 + 16:
+            return None
+        ts = int.from_bytes(token[:8], "big")
+        olen = token[8]
+        if len(token) != 9 + olen + 16:
+            return None
+        odcid = token[9:9 + olen]
+        body, mac = token[:9 + olen], token[9 + olen:]
+        want = hmac.new(self._retry_secret,
+                        body + str(addr[0]).encode(),
+                        hashlib.sha256).digest()[:16]
+        if not hmac.compare_digest(mac, want):
+            return None
+        if abs(time.time() - ts) > max_age:
+            return None
+        return odcid
+
+    def _send_retry(self, odcid: bytes, client_scid: bytes, addr) -> None:
+        new_cid = os.urandom(CID_LEN)
+        retry = P.encode_retry(P.QUIC_V1, client_scid, new_cid, odcid,
+                               self._mint_token(odcid, addr))
+        self._transport.sendto(retry, addr)
+
     def _forget(self, conn: QuicServerConnection) -> None:
         removed = False
-        for key in (conn.odcid, conn.scid):
+        for key in getattr(conn, "route_keys", (conn.odcid, conn.scid)):
             if self._conns.pop(key, None) is not None:
                 removed = True
         if removed:

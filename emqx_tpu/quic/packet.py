@@ -122,6 +122,54 @@ class PacketError(Exception):
     pass
 
 
+# ---- Retry packets (RFC 9000 §17.2.5 + RFC 9001 §5.8 integrity tag) ----
+# fixed v1 key/nonce from RFC 9001 §5.8
+RETRY_KEY = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
+RETRY_NONCE = bytes.fromhex("461599d35d632bf2239825bb")
+
+
+def _retry_pseudo(odcid: bytes, retry_no_tag: bytes) -> bytes:
+    return bytes([len(odcid)]) + odcid + retry_no_tag
+
+
+def encode_retry(version: int, dcid: bytes, scid: bytes, odcid: bytes,
+                 token: bytes) -> bytes:
+    """Build a Retry packet (server -> client, address validation)."""
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    first = 0xC0 | (PT_RETRY << 4)
+    pkt = (bytes([first]) + struct.pack(">I", version)
+           + bytes([len(dcid)]) + dcid + bytes([len(scid)]) + scid + token)
+    tag = AESGCM(RETRY_KEY).encrypt(RETRY_NONCE, b"",
+                                    _retry_pseudo(odcid, pkt))
+    return pkt + tag
+
+
+def decode_retry(datagram: bytes, odcid: bytes):
+    """Parse + integrity-check a Retry. -> (scid, token) or None when the
+    tag does not verify (RFC 9001 §5.8: MUST discard on mismatch)."""
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    if len(datagram) < 23 or (datagram[0] & 0xB0) != 0xB0:
+        return None
+    p = 5
+    dlen = datagram[p]
+    p += 1 + dlen
+    if p >= len(datagram):
+        return None
+    slen = datagram[p]
+    scid = datagram[p + 1:p + 1 + slen]
+    p += 1 + slen
+    if p + 16 > len(datagram):
+        return None
+    token = datagram[p:-16]
+    tag = datagram[-16:]
+    try:
+        AESGCM(RETRY_KEY).decrypt(
+            RETRY_NONCE, tag, _retry_pseudo(odcid, datagram[:-16]))
+    except Exception:  # noqa: BLE001 — invalid tag: discard
+        return None
+    return scid, token
+
+
 def peek_header(datagram: bytes, pos: int,
                 short_dcid_len: int) -> tuple[int, bytes, bytes, bytes, int, int]:
     """Parse the unprotected parts: -> (ptype, dcid, scid, token,
@@ -201,6 +249,7 @@ TP_MAX_STREAMS_BIDI = 0x08
 TP_MAX_STREAMS_UNI = 0x09
 TP_INITIAL_SCID = 0x0F
 TP_ORIGINAL_DCID = 0x00
+TP_RETRY_SCID = 0x10
 
 
 def encode_transport_params(params: dict[int, "int | bytes"]) -> bytes:

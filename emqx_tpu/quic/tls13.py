@@ -50,6 +50,21 @@ HT_CERTIFICATE_VERIFY = 15
 HT_FINISHED = 20
 
 
+def _system_cafile() -> Optional[str]:
+    """Best-effort system trust bundle path (OpenSSL default paths plus
+    the usual distro locations)."""
+    import os
+    import ssl
+    paths = ssl.get_default_verify_paths()
+    for p in (paths.cafile, paths.openssl_cafile,
+              "/etc/ssl/certs/ca-certificates.crt",
+              "/etc/pki/tls/certs/ca-bundle.crt",
+              "/etc/ssl/cert.pem"):
+        if p and os.path.isfile(p):
+            return p
+    return None
+
+
 class TlsError(Exception):
     def __init__(self, msg: str, alert: int = 40):   # handshake_failure
         self.alert = alert
@@ -341,11 +356,35 @@ class Tls13Server(_Base):
 
 class Tls13Client(_Base):
     def __init__(self, server_name: str, alpn_protocols: list[str],
-                 transport_params: bytes, cafile: Optional[str] = None):
+                 transport_params: bytes, cafile: Optional[str] = None,
+                 verify: str = "required"):
+        """verify='required' (default): the server chain MUST validate
+        against `cafile`, or the system trust store when cafile is None —
+        there is no silent fall-through to unauthenticated encryption.
+        verify='none' is an explicit opt-out (test rigs, pinned
+        deployments) and logs loudly. The reference gets the same default
+        from msquic/platform validation."""
         super().__init__()
         self.server_name = server_name
         self._alpn = alpn_protocols
         self._tp = transport_params
+        if verify not in ("required", "none"):
+            raise ValueError(f"verify must be 'required' or 'none', "
+                             f"got {verify!r}")
+        self._verify = verify
+        if verify == "required" and cafile is None:
+            cafile = _system_cafile()
+            if cafile is None:
+                raise ValueError(
+                    "no CA bundle found: pass cafile=..., or opt out "
+                    "explicitly with verify='none'")
+        if verify == "none":
+            import logging
+            logging.getLogger("emqx.quic").warning(
+                "QUIC TLS verify='none': server certificate and hostname "
+                "are NOT verified (connection is encrypted but "
+                "unauthenticated)")
+            cafile = None
         self._cafile = cafile
         self._priv = X25519PrivateKey.generate()
         self._server_cert = None
@@ -436,8 +475,33 @@ class Tls13Client(_Base):
         if self._cafile:
             self._verify_chain(chain)
 
+    @staticmethod
+    def _is_ca(cert) -> bool:
+        """RFC 5280 §4.2.1.9/.3: a cert may act as an issuer only with
+        basicConstraints CA=true and (when KeyUsage is present)
+        keyCertSign. Without this check any holder of an ordinary
+        end-entity cert from a trusted CA could sign a fake leaf for an
+        arbitrary hostname and MITM the connection."""
+        from cryptography import x509
+        try:
+            bc = cert.extensions.get_extension_for_class(
+                x509.BasicConstraints).value
+            if not bc.ca:
+                return False
+        except x509.ExtensionNotFound:
+            return False
+        try:
+            ku = cert.extensions.get_extension_for_class(
+                x509.KeyUsage).value
+            if not ku.key_cert_sign:
+                return False
+        except x509.ExtensionNotFound:
+            pass
+        return True
+
     def _verify_chain(self, chain: list) -> None:
-        """Leaf -> (intermediates) -> trusted CA, plus validity period and
+        """Leaf -> (intermediates) -> trusted CA, plus validity period,
+        intermediate CA constraints (basicConstraints/keyUsage) and
         hostname (SAN dNSName, wildcard leftmost label; CN fallback)."""
         import datetime
 
@@ -449,7 +513,8 @@ class Tls13Client(_Base):
             if not (cert.not_valid_before_utc <= now
                     <= cert.not_valid_after_utc):
                 raise TlsError("certificate outside validity period", 45)
-        # walk up: each link verified by the next chain entry or a root
+        # walk up: each link verified by the next chain entry or a root;
+        # wire-supplied intermediates must satisfy the CA constraints
         cur = chain[0]
         rest = chain[1:]
         trusted = False
@@ -465,6 +530,8 @@ class Tls13Client(_Base):
                 break
             nxt = None
             for cand in rest:
+                if not self._is_ca(cand):
+                    continue
                 try:
                     cur.verify_directly_issued_by(cand)
                     nxt = cand

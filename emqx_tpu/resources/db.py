@@ -56,6 +56,16 @@ class RedisResource(_PooledDbResource):
 
     def _make_client(self) -> RedisClient:
         c = self.conf
+        if c.get("redis_type") == "sentinel" or c.get("sentinels"):
+            # emqx_connector_redis.erl sentinel mode: servers are the
+            # sentinels, `sentinel` names the master set
+            from emqx_tpu.connectors.redis import SentinelRedisClient
+            return SentinelRedisClient(
+                sentinels=[tuple(s) for s in c.get("sentinels", [])],
+                master_name=c.get("sentinel", "mymaster"),
+                username=c.get("username"), password=c.get("password"),
+                sentinel_password=c.get("sentinel_password"),
+                database=int(c.get("database", 0)), ssl=c.get("ssl"))
         return RedisClient(
             host=c.get("host", "127.0.0.1"), port=c.get("port", 6379),
             username=c.get("username"), password=c.get("password"),

@@ -59,9 +59,15 @@ class _FakeServer:
 class FakeRedis(_FakeServer):
     """RESP2 server: AUTH/SELECT/PING + hash commands over a dict store."""
 
-    def __init__(self, password: Optional[str] = None):
+    def __init__(self, password: Optional[str] = None,
+                 role: str = "master",
+                 masters: Optional[dict] = None):
         super().__init__()
         self.password = password
+        self.role = role              # ROLE reply (master/replica)
+        # sentinel mode: master_name -> (host, port) for
+        # SENTINEL get-master-addr-by-name
+        self.masters = masters
         self.hashes: dict[str, dict[str, str]] = {}
         self.commands: list[list[bytes]] = []
 
@@ -105,6 +111,17 @@ class FakeRedis(_FakeServer):
                 writer.write(b"+OK\r\n")
             elif cmd == b"PING":
                 writer.write(b"+PONG\r\n")
+            elif cmd == b"ROLE":
+                writer.write(b"*3\r\n" + self._bulk(self.role)
+                             + b":0\r\n*0\r\n")
+            elif cmd == b"SENTINEL":
+                name = args[2].decode() if len(args) > 2 else ""
+                m = (self.masters or {}).get(name)
+                if m is None:
+                    writer.write(b"*-1\r\n")
+                else:
+                    writer.write(b"*2\r\n" + self._bulk(str(m[0]))
+                                 + self._bulk(str(m[1])))
             elif cmd == b"HGETALL":
                 h = self.hashes.get(args[1].decode(), {})
                 out = [b"*%d\r\n" % (len(h) * 2)]
@@ -135,21 +152,47 @@ def _mysql_scramble(password: bytes, nonce: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(h1, h3))
 
 
+def _sha2_scramble(password: bytes, nonce: bytes) -> bytes:
+    if not password:
+        return b""
+    h1 = hashlib.sha256(password).digest()
+    h2 = hashlib.sha256(hashlib.sha256(h1).digest() + nonce).digest()
+    return bytes(a ^ b for a, b in zip(h1, h2))
+
+
 class FakeMysql(_FakeServer):
-    """Protocol-v10 server: native-password handshake + COM_QUERY routed
-    to `handler(sql) -> (columns, rows) | None` (None -> OK packet)."""
+    """Protocol-v10 server: native or caching_sha2 handshake (fast path
+    when `sha2_cached`, else full auth via RSA public-key exchange),
+    COM_QUERY text resultsets, and COM_STMT_PREPARE/EXECUTE binary
+    resultsets, routed to `handler(sql) -> (columns, rows) | None`
+    (None -> OK packet). Prepared executions are recorded in
+    `self.prepared` as (sql, params) so tests can assert parameters never
+    entered the SQL text."""
 
     def __init__(self, username: str = "root", password: str = "",
-                 handler: Optional[Callable] = None):
+                 handler: Optional[Callable] = None,
+                 plugin: str = "mysql_native_password",
+                 sha2_cached: bool = False):
         super().__init__()
         self.username = username
         self.password = password
         self.handler = handler or (lambda sql: ([], []))
+        self.plugin = plugin
+        self.sha2_cached = sha2_cached
         self.queries: list[str] = []
+        self.prepared: list[tuple] = []
+        self._rsa_key = None
 
     @staticmethod
     def _lenenc_str(b: bytes) -> bytes:
         return bytes([len(b)]) + b
+
+    def _rsa(self):
+        if self._rsa_key is None:
+            from cryptography.hazmat.primitives.asymmetric import rsa
+            self._rsa_key = rsa.generate_private_key(
+                public_exponent=65537, key_size=2048)
+        return self._rsa_key
 
     async def session(self, reader, writer):
         seq = 0
@@ -176,7 +219,7 @@ class FakeMysql(_FakeServer):
                  + struct.pack("<H", 0x000F)                  # caps hi
                  + bytes([21]) + b"\x00" * 10
                  + nonce[8:] + b"\x00"
-                 + b"mysql_native_password\x00")
+                 + self.plugin.encode() + b"\x00")
         send(greet)
         await writer.drain()
         resp = await recv()
@@ -187,15 +230,62 @@ class FakeMysql(_FakeServer):
         pos = end + 1
         alen = resp[pos]
         auth = resp[pos + 1:pos + 1 + alen]
-        expect = _mysql_scramble(self.password.encode(), nonce)
-        if user != self.username or auth != expect:
-            msg = b"Access denied"
-            send(b"\xff" + struct.pack("<H", 1045) + b"#28000" + msg)
+
+        def deny():
+            send(b"\xff" + struct.pack("<H", 1045) + b"#28000"
+                 + b"Access denied")
+
+        pw = self.password.encode()
+        if user != self.username:
+            deny()
             await writer.drain()
             return
+        if self.plugin == "caching_sha2_password":
+            if auth != _sha2_scramble(pw, nonce):
+                deny()
+                await writer.drain()
+                return
+            if self.sha2_cached:
+                send(b"\x01\x03")                 # fast auth success
+            else:
+                send(b"\x01\x04")                 # full authentication
+                await writer.drain()
+                req = await recv()
+                if req == b"\x02":                # public key request
+                    from cryptography.hazmat.primitives import (
+                        hashes, serialization)
+                    from cryptography.hazmat.primitives.asymmetric import (
+                        padding)
+                    pem = self._rsa().public_key().public_bytes(
+                        serialization.Encoding.PEM,
+                        serialization.PublicFormat.SubjectPublicKeyInfo)
+                    send(b"\x01" + pem)
+                    await writer.drain()
+                    enc = await recv()
+                    xored = self._rsa().decrypt(enc, padding.OAEP(
+                        mgf=padding.MGF1(hashes.SHA1()),
+                        algorithm=hashes.SHA1(), label=None))
+                    got = bytes(b ^ nonce[i % len(nonce)]
+                                for i, b in enumerate(xored))
+                    if got != pw + b"\x00":
+                        deny()
+                        await writer.drain()
+                        return
+                else:                             # cleartext (TLS channel)
+                    if req.rstrip(b"\x00") != pw:
+                        deny()
+                        await writer.drain()
+                        return
+        else:
+            if auth != _mysql_scramble(pw, nonce):
+                deny()
+                await writer.drain()
+                return
         send(b"\x00\x00\x00\x02\x00\x00\x00")                 # OK
         await writer.drain()
 
+        stmts: dict[int, tuple[str, int]] = {}
+        next_stmt = [1]
         while True:
             seq = 0
             pkt = await recv()
@@ -204,6 +294,55 @@ class FakeMysql(_FakeServer):
                 return
             if com == b"\x0e":                                # COM_PING
                 send(b"\x00\x00\x00\x02\x00\x00\x00")
+                await writer.drain()
+                continue
+            if com == b"\x16":                                # STMT_PREPARE
+                sql = pkt[1:].decode()
+                sid = next_stmt[0]
+                next_stmt[0] += 1
+                nparams = sql.count("?")
+                stmts[sid] = (sql, nparams)
+                send(b"\x00" + struct.pack("<IHHBH", sid, 0, nparams, 0, 0))
+                for _ in range(nparams):
+                    send(self._coldef(b"?"))
+                if nparams:
+                    send(b"\xfe\x00\x00\x02\x00")
+                await writer.drain()
+                continue
+            if com == b"\x19":                                # STMT_CLOSE
+                stmts.pop(struct.unpack_from("<I", pkt, 1)[0], None)
+                continue
+            if com == b"\x17":                                # STMT_EXECUTE
+                sid = struct.unpack_from("<I", pkt, 1)[0]
+                sql, nparams = stmts[sid]
+                params = self._parse_exec_params(pkt, nparams)
+                self.prepared.append((sql, params))
+                result = self.handler(sql, params) \
+                    if self.handler.__code__.co_argcount > 1 \
+                    else self.handler(sql)
+                if result is None:
+                    send(b"\x00\x00\x00\x02\x00\x00\x00")
+                    await writer.drain()
+                    continue
+                columns, rows = result
+                send(bytes([len(columns)]))
+                for name in columns:
+                    send(self._coldef(name.encode()))
+                send(b"\xfe\x00\x00\x02\x00")
+                nbm = (len(columns) + 9) // 8
+                for row in rows:
+                    bitmap = bytearray(nbm)
+                    vals = b""
+                    for i, v in enumerate(row):
+                        if v is None:
+                            bitmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+                        else:
+                            vb = str(v).encode()
+                            vals += self._lenenc_str(vb) if len(vb) < 251 \
+                                else b"\xfc" + struct.pack("<H", len(vb)) \
+                                + vb
+                    send(b"\x00" + bytes(bitmap) + vals)
+                send(b"\xfe\x00\x00\x02\x00")
                 await writer.drain()
                 continue
             if com != b"\x03":
@@ -221,14 +360,7 @@ class FakeMysql(_FakeServer):
             columns, rows = result
             send(bytes([len(columns)]))
             for name in columns:
-                nb = name.encode()
-                cdef = (self._lenenc_str(b"def") + self._lenenc_str(b"db")
-                        + self._lenenc_str(b"t") + self._lenenc_str(b"t")
-                        + self._lenenc_str(nb) + self._lenenc_str(nb)
-                        + b"\x0c" + struct.pack("<H", 0x21)
-                        + struct.pack("<I", 255) + b"\xfd"
-                        + struct.pack("<H", 0) + b"\x00" + b"\x00\x00")
-                send(cdef)
+                send(self._coldef(name.encode()))
             send(b"\xfe\x00\x00\x02\x00")                     # EOF
             for row in rows:
                 out = b""
@@ -242,6 +374,54 @@ class FakeMysql(_FakeServer):
                 send(out)
             send(b"\xfe\x00\x00\x02\x00")                     # EOF
             await writer.drain()
+
+    def _coldef(self, name: bytes) -> bytes:
+        return (self._lenenc_str(b"def") + self._lenenc_str(b"db")
+                + self._lenenc_str(b"t") + self._lenenc_str(b"t")
+                + self._lenenc_str(name) + self._lenenc_str(name)
+                + b"\x0c" + struct.pack("<H", 0x21)
+                + struct.pack("<I", 255) + b"\xfd"
+                + struct.pack("<H", 0) + b"\x00" + b"\x00\x00")
+
+    @staticmethod
+    def _parse_exec_params(pkt: bytes, nparams: int) -> list:
+        """Decode COM_STMT_EXECUTE parameter values (subset of types the
+        client sends: NULL/LONGLONG/DOUBLE/VAR_STRING)."""
+        pos = 1 + 4 + 1 + 4
+        nbm = (nparams + 7) // 8
+        bitmap = pkt[pos:pos + nbm]
+        pos += nbm
+        if nparams == 0 or pkt[pos] != 1:
+            return []
+        pos += 1
+        types = []
+        for _ in range(nparams):
+            types.append(struct.unpack_from("<H", pkt, pos)[0])
+            pos += 2
+        out = []
+        for i, t in enumerate(types):
+            if bitmap[i // 8] & (1 << (i % 8)):
+                out.append(None)
+                continue
+            if t == 0x08:
+                out.append(struct.unpack_from("<q", pkt, pos)[0])
+                pos += 8
+            elif t == 0x05:
+                out.append(struct.unpack_from("<d", pkt, pos)[0])
+                pos += 8
+            else:
+                first = pkt[pos]
+                if first < 0xFB:
+                    n, pos = first, pos + 1
+                elif first == 0xFC:
+                    n = struct.unpack_from("<H", pkt, pos + 1)[0]
+                    pos += 3
+                else:
+                    n = int.from_bytes(pkt[pos + 1:pos + 4], "little")
+                    pos += 4
+                out.append(pkt[pos:pos + n].decode())
+                pos += n
+        return out
 
 
 class FakePgsql(_FakeServer):

@@ -196,8 +196,12 @@ class TestMysql:
                 "WHERE username = ? AND note = ?", ["alice", "o'brien"])
             assert cols == ["password_hash", "salt"]
             assert rows == [["abc", None]]
-            # params escaped into the SQL text
-            assert "o\\'brien" in srv.queries[-1]
+            # server-side prepared statement: the parameters never enter
+            # the SQL text (no client-side escaping to subvert via
+            # sql_mode NO_BACKSLASH_ESCAPES — ADVICE round-2)
+            sql_sent, params_sent = srv.prepared[-1]
+            assert "o'brien" not in sql_sent and "?" in sql_sent
+            assert params_sent == ["alice", "o'brien"]
             cols, rows = await c.query("UPDATE x SET y = 1")
             assert (cols, rows) == ([], [])
             await c.close()
@@ -345,8 +349,8 @@ class TestDbAuthn:
         assert "$1" in q
 
     def test_mysql_authn(self, loop):
-        def handler(sql):
-            if "'alice'" in sql:
+        def handler(sql, params=None):
+            if params and "alice" in params:
                 return (["password_hash", "salt", "is_superuser"],
                         [[_hash("w0nder"), "s1", "1"]])
             return (["password_hash", "salt", "is_superuser"], [])
@@ -443,9 +447,10 @@ class TestDbAuthz:
     def test_sql_sources(self, loop):
         rows = [["allow", "subscribe", "t/+"], ["deny", "all", "t/#"]]
 
-        def handler(sql):
+        def handler(sql, params=None):
+            hit = (params and "u1" in params) or "'u1'" in sql
             return (["permission", "action", "topic"],
-                    rows if "'u1'" in sql else [])
+                    rows if hit else [])
 
         async def go():
             node = Node(use_device=False)
@@ -538,8 +543,8 @@ class TestEnhancedAuthEndToEnd:
         assert node.metrics.val("client.auth.success") >= 2
 
     def test_mysql_authn_end_to_end(self, loop):
-        def handler(sql):
-            if "'alice'" in sql:
+        def handler(sql, params=None):
+            if params and "alice" in params:
                 return (["password_hash", "salt"],
                         [[_hash("w0nder"), "s1"]])
             return ([], [])
@@ -636,5 +641,221 @@ class TestLdap:
             assert rows and rows[0]["dn"] == "uid=u,dc=x"
             assert await res.health_check()
             await mgr.remove("ld")
+            await srv.stop()
+        run(loop, go())
+
+
+class TestMysqlCachingSha2:
+    """MySQL 8's default auth plugin (round-2 VERDICT missing #2): fast
+    path (server has the credential cached) and full path (RSA public-key
+    exchange over a plain connection). Parity: mysql-otp via
+    emqx_connector_mysql.erl."""
+
+    def test_fast_path(self, loop):
+        async def go():
+            srv = await FakeMysql(username="u8", password="pw8",
+                                  plugin="caching_sha2_password",
+                                  sha2_cached=True).start()
+            c = MysqlClient(port=srv.port, username="u8", password="pw8")
+            await c.connect()
+            assert await c.ping()
+            await c.close()
+            await srv.stop()
+        run(loop, go())
+
+    def test_full_path_rsa(self, loop):
+        async def go():
+            srv = await FakeMysql(username="u8", password="pw8",
+                                  plugin="caching_sha2_password",
+                                  sha2_cached=False).start()
+            c = MysqlClient(port=srv.port, username="u8", password="pw8")
+            await c.connect()
+            assert await c.ping()
+            await c.close()
+            await srv.stop()
+        run(loop, go())
+
+    def test_wrong_password_denied(self, loop):
+        async def go():
+            srv = await FakeMysql(username="u8", password="pw8",
+                                  plugin="caching_sha2_password",
+                                  sha2_cached=True).start()
+            c = MysqlClient(port=srv.port, username="u8", password="nope")
+            with pytest.raises(MysqlError):
+                await c.connect()
+            await srv.stop()
+        run(loop, go())
+
+
+class TestRedisSentinel:
+    """Sentinel mode (round-2 VERDICT missing #6): master resolution via
+    SENTINEL get-master-addr-by-name, ROLE verification, and failover
+    follow-through on reconnect. Parity: emqx_connector_redis.erl
+    single|sentinel modes (eredis_sentinel)."""
+
+    def test_resolves_master_and_serves(self, loop):
+        from emqx_tpu.connectors.redis import SentinelRedisClient
+
+        async def go():
+            master = await FakeRedis().start()
+            master.hashes["k"] = {"f": "v"}
+            sentinel = await FakeRedis(
+                masters={"mymaster": ("127.0.0.1", master.port)}).start()
+            c = SentinelRedisClient([("127.0.0.1", sentinel.port)],
+                                    "mymaster")
+            await c.connect()
+            assert await c.ping()
+            assert await c.cmd(["HMGET", "k", "f"]) == [b"v"]
+            await c.close()
+            await sentinel.stop()
+            await master.stop()
+        run(loop, go())
+
+    def test_rejects_stale_master(self, loop):
+        """A sentinel answer pointing at a demoted node (ROLE != master)
+        must be refused, not silently written to."""
+        from emqx_tpu.connectors.redis import SentinelRedisClient
+
+        async def go():
+            replica = await FakeRedis(role="replica").start()
+            sentinel = await FakeRedis(
+                masters={"mymaster": ("127.0.0.1", replica.port)}).start()
+            c = SentinelRedisClient([("127.0.0.1", sentinel.port)],
+                                    "mymaster")
+            with pytest.raises(RedisError):
+                await c.connect()
+            await sentinel.stop()
+            await replica.stop()
+        run(loop, go())
+
+    def test_failover_follow_through_pool(self, loop):
+        """After the master dies and the sentinel repoints, the next pool
+        reconnect lands on the new master."""
+        from emqx_tpu.connectors.redis import SentinelRedisClient
+
+        async def go():
+            m1 = await FakeRedis().start()
+            m2 = await FakeRedis().start()
+            m2.hashes["who"] = {"name": "m2"}
+            masters = {"mymaster": ("127.0.0.1", m1.port)}
+            sentinel = await FakeRedis(masters=masters).start()
+            pool = ConnPool(lambda: SentinelRedisClient(
+                [("127.0.0.1", sentinel.port)], "mymaster"), size=1)
+            await pool.start()
+            assert await pool.run(lambda c: c.ping())
+            # failover: m1 dies, sentinel repoints to m2
+            await m1.stop()
+            masters["mymaster"] = ("127.0.0.1", m2.port)
+            got = await pool.run(lambda c: c.cmd(["HMGET", "who", "name"]))
+            assert got == [b"m2"]
+            await pool.stop()
+            await sentinel.stop()
+            await m2.stop()
+        run(loop, go())
+
+    def test_dead_sentinel_skipped(self, loop):
+        from emqx_tpu.connectors.redis import SentinelRedisClient
+
+        async def go():
+            master = await FakeRedis().start()
+            sentinel = await FakeRedis(
+                masters={"mymaster": ("127.0.0.1", master.port)}).start()
+            dead = await FakeRedis().start()
+            await dead.stop()                     # port now refuses
+            c = SentinelRedisClient(
+                [("127.0.0.1", dead.port), ("127.0.0.1", sentinel.port)],
+                "mymaster")
+            await c.connect()
+            assert await c.ping()
+            await c.close()
+            await sentinel.stop()
+            await master.stop()
+        run(loop, go())
+
+    def test_resource_sentinel_config(self, loop):
+        from emqx_tpu.resources.resource import ResourceManager
+
+        async def go():
+            node = Node(use_device=False)
+            master = await FakeRedis().start()
+            sentinel = await FakeRedis(
+                masters={"ms1": ("127.0.0.1", master.port)}).start()
+            mgr = ResourceManager(node)
+            res = await mgr.create("r-sent", "redis", {
+                "redis_type": "sentinel",
+                "sentinels": [["127.0.0.1", sentinel.port]],
+                "sentinel": "ms1"})
+            assert await res.query(["PING"]) == b"PONG"
+            await mgr.remove("r-sent")
+            await sentinel.stop()
+            await master.stop()
+        run(loop, go())
+
+
+class TestLdapAuthn:
+    """LDAP bind as an authn source in a chain (round-2 VERDICT item 9):
+    filter search resolves the DN, a fresh bind checks the credential."""
+
+    def _fake(self):
+        from tests.fake_db import FakeLdap
+        return FakeLdap(
+            binds={"": "", "cn=svc,dc=x": "svcpw",
+                   "uid=alice,ou=people,dc=x": "wonder"},
+            entries=[{"dn": "uid=alice,ou=people,dc=x",
+                      "uid": ["alice"], "isSuperuser": ["1"]},
+                     {"dn": "uid=bob,ou=people,dc=x", "uid": ["bob"]}])
+
+    def test_bind_auth_in_chain(self, loop):
+        from emqx_tpu.apps.authn_db import LdapAuthenticator
+
+        async def go():
+            srv = await self._fake().start()
+            a = LdapAuthenticator(
+                port=srv.port, base_dn="dc=x",
+                filter_tmpl="(uid=${mqtt-username})",
+                bind_dn="cn=svc,dc=x", bind_password="svcpw")
+            v, extra = await a.authenticate_async(
+                {"username": "alice"}, b"wonder")
+            assert v == "ok" and extra["is_superuser"]
+            v, _ = await a.authenticate_async(
+                {"username": "alice"}, b"wrong")
+            assert v == "deny"
+            v, _ = await a.authenticate_async(
+                {"username": "ghost"}, b"x")
+            assert v == "ignore"
+            await srv.stop()
+        run(loop, go())
+
+    def test_chain_falls_through_when_unreachable(self, loop):
+        from emqx_tpu.apps.authn_db import LdapAuthenticator
+
+        async def go():
+            node = Node(use_device=False)
+            dead = await self._fake().start()
+            await dead.stop()
+            # chain: unreachable LDAP (ignore) -> builtin allows
+            from emqx_tpu.apps.authn import AuthnChain, BuiltinDB
+            builtin = BuiltinDB()
+            builtin.add_user("carol", "pw")
+            chain = AuthnChain(node, [
+                LdapAuthenticator(port=dead.port, base_dn="dc=x"),
+                builtin], enable=True)
+            _act, out = await chain.on_authenticate(
+                {"username": "carol", "clientid": "c"},
+                {"password": b"pw"})
+            assert out["ok"] is True
+        run(loop, go())
+
+    def test_and_filter(self, loop):
+        from emqx_tpu.apps.authn_db import LdapAuthenticator
+
+        async def go():
+            srv = await self._fake().start()
+            a = LdapAuthenticator(
+                port=srv.port, base_dn="dc=x",
+                filter_tmpl="(&(uid=${mqtt-username})(uid=alice))")
+            v, _ = await a.authenticate_async(
+                {"username": "alice"}, b"wonder")
+            assert v == "ok"
             await srv.stop()
         run(loop, go())

@@ -6,6 +6,7 @@ emqtt-quic client in its suites) with the in-repo client as the driver.
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -118,7 +119,8 @@ class TestTls13Engine:
         tp = b"\x01\x01\x05"
         srv = T.Tls13Server(certs["certfile"], certs["keyfile"],
                             ["mqtt"], tp)
-        cli = T.Tls13Client(server_name, ["mqtt"], tp, cafile=cafile)
+        cli = T.Tls13Client(server_name, ["mqtt"], tp, cafile=cafile,
+                            verify="required" if cafile else "none")
         cli.start()
         for _ in range(4):
             if srv.complete and cli.complete:
@@ -159,7 +161,7 @@ class TestTls13Engine:
     def test_no_common_alpn(self, certs):
         srv = T.Tls13Server(certs["certfile"], certs["keyfile"],
                             ["mqtt"], b"\x01\x01\x05")
-        cli = T.Tls13Client("x", ["h3"], b"\x01\x01\x05")
+        cli = T.Tls13Client("x", ["h3"], b"\x01\x01\x05", verify="none")
         cli.start()
         with pytest.raises(T.TlsError):
             for lvl, d in cli.pending:
@@ -206,8 +208,8 @@ class TestMqttOverQuic:
                                certfile=certs["certfile"],
                                keyfile=certs["keyfile"])
             await lst.start()
-            qa = QuicClientConnection(port=lst.port)
-            qb = QuicClientConnection(port=lst.port)
+            qa = QuicClientConnection(port=lst.port, verify="none")
+            qb = QuicClientConnection(port=lst.port, verify="none")
             await qa.connect()
             await qb.connect()
             assert lst.current_conns == 2
@@ -240,7 +242,7 @@ class TestMqttOverQuic:
                                certfile=certs["certfile"],
                                keyfile=certs["keyfile"])
             await lst.start()
-            qc = QuicClientConnection(port=lst.port)
+            qc = QuicClientConnection(port=lst.port, verify="none")
             await qc.connect()
             c = Client(clientid="qbig", conn_factory=lambda: _pair(qc))
             await c.connect()
@@ -267,7 +269,7 @@ class TestMqttOverQuic:
                                certfile=certs["certfile"],
                                keyfile=certs["keyfile"])
             await lst.start()
-            qc = QuicClientConnection(port=lst.port)
+            qc = QuicClientConnection(port=lst.port, verify="none")
             await qc.connect()
             c = Client(clientid="qfc", conn_factory=lambda: _pair(qc))
             await c.connect()
@@ -294,7 +296,7 @@ class TestMqttOverQuic:
                                certfile=certs["certfile"],
                                keyfile=certs["keyfile"])
             await lst.start()
-            qc = QuicClientConnection(port=lst.port)
+            qc = QuicClientConnection(port=lst.port, verify="none")
             await qc.connect()
             assert lst.current_conns == 1
             # client goes silent: server must reap the connection
@@ -316,7 +318,7 @@ class TestMqttOverQuic:
         async def go():
             [lst] = await node.start_listeners()
             assert isinstance(lst, QuicListener)
-            qc = QuicClientConnection(port=lst.port)
+            qc = QuicClientConnection(port=lst.port, verify="none")
             await qc.connect()
             c = Client(clientid="qc", conn_factory=lambda: _pair(qc))
             await c.connect()
@@ -328,3 +330,333 @@ class TestMqttOverQuic:
 
 async def _pair(qc):
     return qc.open_stream()
+
+
+class TestChainSecurity:
+    """ADVICE round-2: certificate-chain hardening.
+
+    - An ordinary end-entity cert (no basicConstraints CA=true) must NOT be
+      usable as an intermediate issuer — otherwise any leaf-holder under a
+      trusted CA can mint certificates for arbitrary hostnames (full MITM).
+    - Verification is ON by default: cafile=None resolves the system trust
+      store instead of silently skipping verification.
+    """
+
+    def _mk_chain(self, tmp_path):
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+
+        def key():
+            return rsa.generate_private_key(public_exponent=65537,
+                                            key_size=2048)
+
+        def name(cn):
+            return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+        def build(cn, issuer_cn, pubkey, signer, ca=None, san=None):
+            b = (x509.CertificateBuilder()
+                 .subject_name(name(cn)).issuer_name(name(issuer_cn))
+                 .public_key(pubkey)
+                 .serial_number(x509.random_serial_number())
+                 .not_valid_before(now - datetime.timedelta(days=1))
+                 .not_valid_after(now + datetime.timedelta(days=30)))
+            if ca is not None:
+                b = b.add_extension(
+                    x509.BasicConstraints(ca=ca, path_length=None),
+                    critical=True)
+            if san:
+                b = b.add_extension(x509.SubjectAlternativeName(
+                    [x509.DNSName(san)]), critical=False)
+            return b.sign(signer, hashes.SHA256())
+
+        ca_key = key()
+        ca = build("test-ca", "test-ca", ca_key.public_key(), ca_key,
+                   ca=True)
+        # ordinary end-entity cert issued by the CA (CA=false)
+        ee_key = key()
+        ee = build("victim-ee", "test-ca", ee_key.public_key(), ca_key,
+                   ca=False, san="victim.example")
+        # attacker-minted leaf for localhost, signed with the EE key
+        fake_key = key()
+        fake = build("localhost", "victim-ee", fake_key.public_key(),
+                     ee_key, san="localhost")
+        # legitimate intermediate (CA=true) + its leaf, for the positive
+        inter_key = key()
+        inter = build("test-inter", "test-ca", inter_key.public_key(),
+                      ca_key, ca=True)
+        leaf_key = key()
+        leaf = build("localhost", "test-inter", leaf_key.public_key(),
+                     inter_key, san="localhost")
+        cafile = str(tmp_path / "ca.pem")
+        with open(cafile, "wb") as f:
+            f.write(ca.public_bytes(serialization.Encoding.PEM))
+        return cafile, fake, ee, leaf, inter
+
+    def test_end_entity_cannot_act_as_issuer(self, tmp_path):
+        cafile, fake, ee, _leaf, _inter = self._mk_chain(tmp_path)
+        cli = T.Tls13Client("localhost", ["mqtt"], b"", cafile=cafile)
+        with pytest.raises(T.TlsError):
+            cli._verify_chain([fake, ee])
+
+    def test_real_intermediate_accepted(self, tmp_path):
+        cafile, _fake, _ee, leaf, inter = self._mk_chain(tmp_path)
+        cli = T.Tls13Client("localhost", ["mqtt"], b"", cafile=cafile)
+        cli._verify_chain([leaf, inter])   # no raise
+
+    def test_verify_required_by_default(self, loop, certs):
+        """QuicClientConnection with no cafile must verify against the
+        system store and REJECT the self-signed test server."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port)
+            try:
+                with pytest.raises(Exception):
+                    await qc.connect(timeout=5)
+            finally:
+                qc.close(0, "", app=True)
+                await lst.stop()
+        run(loop, go())
+
+    def test_verify_mode_validated(self):
+        with pytest.raises(ValueError):
+            T.Tls13Client("x", [], b"", verify="maybe")
+
+
+class TestQuicHardening:
+    """Round-3 QUIC hardening (VERDICT item 7 + ADVICE): stateless Retry,
+    anti-amplification, authenticated address migration, inbound flow
+    enforcement, and NewReno loss recovery. The reference inherits these
+    from msquic (emqx_quic_connection.erl + quicer)."""
+
+    def test_retry_roundtrip(self, loop, certs):
+        """With address validation on, the client transparently follows
+        the Retry (new CID + token) and completes the handshake."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"], retry=True)
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect()
+            assert qc._saw_retry
+            assert qc.initial_token
+            c = Client(clientid="qr", conn_factory=lambda: _pair(qc))
+            ack = await c.connect()
+            assert ack.reason_code == 0
+            await c.disconnect()
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go())
+
+    def test_retry_integrity_tag(self):
+        odcid = b"\x11" * 8
+        retry = P.encode_retry(P.QUIC_V1, b"\xaa" * 8, b"\xbb" * 8,
+                               odcid, b"tok")
+        assert P.decode_retry(retry, odcid) == (b"\xbb" * 8, b"tok")
+        # wrong odcid -> tag mismatch -> discarded
+        assert P.decode_retry(retry, b"\x22" * 8) is None
+        # tampered token -> discarded
+        bad = bytearray(retry)
+        bad[-20] ^= 0xFF
+        assert P.decode_retry(bytes(bad), odcid) is None
+
+    def test_token_bound_to_address(self, loop, certs):
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"], retry=True)
+            await lst.start()
+            tok = lst._mint_token(b"\x01" * 8, ("10.0.0.1", 1234))
+            assert lst._check_token(tok, ("10.0.0.1", 9)) == b"\x01" * 8
+            assert lst._check_token(tok, ("10.0.0.2", 9)) is None
+            assert lst._check_token(tok[:-1], ("10.0.0.1", 9)) is None
+            await lst.stop()
+        run(loop, go())
+
+    def test_anti_amplification_cap(self, loop, certs):
+        """A server must send at most 3x the bytes received before the
+        path validates — the cert flight cannot amplify a spoofed
+        Initial (RFC 9000 §8.1)."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+
+            sent = []
+
+            class FakeTransport:
+                def sendto(self, data, addr=None):
+                    sent.append(len(data))
+
+                def get_extra_info(self, *a, **k):
+                    return ("127.0.0.1", 0)
+
+                def close(self):
+                    pass
+
+            # a real client Initial datagram, delivered from a (spoofed)
+            # address the attacker does not control
+            qc = QuicClientConnection(port=1, verify="none")
+            grabbed = []
+
+            class Grab:
+                def sendto(self, data, addr=None):
+                    grabbed.append(data)
+
+            qc.transport = Grab()
+            qc.tls.start()
+            qc._pump_tls()
+            qc.flush()
+            assert grabbed
+            rx_bytes = sum(len(g) for g in grabbed)
+
+            lst._transport = FakeTransport()
+            for g in grabbed:
+                lst._on_datagram(g, ("198.51.100.7", 4433))
+            # server responded, but under the 3x cap — without the cap the
+            # ServerHello+cert flight is several datagrams of amplification
+            assert sum(sent) <= 3 * rx_bytes
+            await lst.stop()
+        run(loop, go())
+
+    def test_spoofed_datagram_cannot_move_address(self, loop, certs):
+        """A garbage datagram carrying an observed DCID from a different
+        address must NOT redirect the connection (ADVICE round-2)."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect()
+            [conn] = set(lst._conns.values())
+            good_addr = conn.addr
+            # spoof: valid header with known DCID, junk ciphertext
+            spoof = bytes([0x40]) + conn.dcid + b"\x00" * 32
+            lst._on_datagram(spoof, ("203.0.113.9", 1))
+            assert conn.addr == good_addr, \
+                "unauthenticated datagram moved the peer address"
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go())
+
+    def test_stream_flow_violation_closes(self, loop, certs):
+        """Stream data beyond the advertised credit closes the connection
+        with FLOW_CONTROL_ERROR instead of buffering it."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect()
+            [conn] = set(lst._conns.values())
+            # bypass the client's own limiter: inject a stream frame far
+            # beyond the advertised window straight into the server conn
+            from emqx_tpu.quic.connection import STREAM_WINDOW
+            fr = F.Stream(0, STREAM_WINDOW + 10_000, b"x", False)
+            conn._on_stream_frame(fr)
+            assert conn.closed
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go())
+
+    def test_stream_limit_enforced(self, loop, certs):
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect()
+            [conn] = set(lst._conns.values())
+            from emqx_tpu.quic.connection import MAX_STREAMS_BIDI
+            fr = F.Stream(4 * MAX_STREAMS_BIDI, 0, b"x", False)
+            conn._on_stream_frame(fr)
+            assert conn.closed
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go())
+
+    def test_loss_recovery_under_drops(self, loop, certs):
+        """MQTT over a lossy path: every 3rd datagram dropped in both
+        directions; the handshake and a pub/sub round still complete via
+        packet-threshold + PTO retransmission, and the congestion window
+        reacted to the losses."""
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+
+            class Dropper:
+                def __init__(self, inner):
+                    self.inner = inner
+                    self.n = 0
+
+                def sendto(self, data, addr=None):
+                    self.n += 1
+                    if self.n % 3 == 0:
+                        return          # dropped
+                    self.inner.sendto(data, addr)
+
+                def __getattr__(self, name):
+                    return getattr(self.inner, name)
+
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect(timeout=20)
+            qc.transport = Dropper(qc.transport)
+            c = Client(clientid="lossy", conn_factory=lambda: _pair(qc))
+            ack = await c.connect()
+            assert ack.reason_code == 0
+            await c.subscribe("lossy/t", qos=1)
+            for i in range(20):
+                await c.publish("lossy/t", b"m%d" % i, qos=1)
+            got = 0
+            for _ in range(20):
+                m = await asyncio.wait_for(c.messages.get(), 20)
+                got += 1
+            assert got == 20
+            await c.disconnect()
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go(), timeout=60)
+
+    def test_newreno_halves_on_loss(self, loop, certs):
+        async def go():
+            node = Node(use_device=False)
+            lst = QuicListener(node, bind="127.0.0.1", port=0,
+                               certfile=certs["certfile"],
+                               keyfile=certs["keyfile"])
+            await lst.start()
+            qc = QuicClientConnection(port=lst.port, verify="none")
+            await qc.connect()
+            from emqx_tpu.quic import connection as QC
+            cw0 = qc.cwnd
+            qc._congestion_event(time.monotonic())
+            assert qc.cwnd == max(cw0 // 2, QC.MIN_CWND)
+            # second loss in the same recovery window: no double-halving
+            cw1 = qc.cwnd
+            qc._congestion_event(time.monotonic() - 10)
+            assert qc.cwnd == cw1
+            qc.close(0, "", app=True)
+            await lst.stop()
+        run(loop, go())
