@@ -104,8 +104,187 @@ class TopicIndex:
         yield from walk(self._root, 0, 0)
 
 
+class RetainerStorage:
+    """Pluggable retained-message store (emqx_retainer_mnesia.erl:49-55
+    behaviour analog: the reference selects mnesia ram/disc/disc_only
+    copies; here a backend object with this interface).
+
+    Entries are (Message, expire_at_ms | None); expiry policy lives in
+    Retainer — backends only store and match.
+    """
+
+    def insert(self, topic: str, msg: Message,
+               expire_at: Optional[int]) -> None:
+        raise NotImplementedError
+
+    def delete(self, topic: str) -> bool:
+        raise NotImplementedError
+
+    def get(self, topic: str):
+        """-> (Message, expire_at) or None."""
+        raise NotImplementedError
+
+    def match_topics(self, filt: str) -> list[str]:
+        raise NotImplementedError
+
+    def items(self):
+        """-> iterable of (topic, Message, expire_at)."""
+        raise NotImplementedError
+
+    def clear(self) -> int:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class RamStorage(RetainerStorage):
+    """In-memory backend (the reference's ram_copies default)."""
+
+    def __init__(self):
+        self._store: dict[str, tuple[Message, Optional[int]]] = {}
+        self._index = TopicIndex()
+
+    def insert(self, topic, msg, expire_at):
+        if topic not in self._store:
+            self._index.insert(topic)
+        self._store[topic] = (msg, expire_at)
+
+    def delete(self, topic):
+        if self._store.pop(topic, None) is None:
+            return False
+        self._index.delete(topic)
+        return True
+
+    def get(self, topic):
+        return self._store.get(topic)
+
+    def match_topics(self, filt):
+        return list(self._index.match(filt))
+
+    def items(self):
+        return [(t, m, exp) for t, (m, exp) in self._store.items()]
+
+    def clear(self):
+        n = len(self._store)
+        self._store.clear()
+        self._index = TopicIndex()
+        return n
+
+    def __len__(self):
+        return len(self._store)
+
+
+class DiscStorage(RamStorage):
+    """Write-through disk backend (the reference's disc_copies — ram reads
+    + durable writes; `disc_only` maps here too, the distinction in mnesia
+    is memory residency, not semantics). A JSONL journal of set/del
+    records replays on open and compacts when it grows past 4x the live
+    entry count."""
+
+    def __init__(self, dirpath: str):
+        super().__init__()
+        import os
+        os.makedirs(dirpath, exist_ok=True)
+        self.path = os.path.join(dirpath, "retained.jsonl")
+        self._journal_lines = 0
+        self._fh = None
+        self._load()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _load(self) -> None:
+        import json
+        import os
+
+        from emqx_tpu.broker.persistence import _dec_deep
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ent = _dec_deep(json.loads(line))
+                except ValueError:
+                    continue        # torn tail write: ignore
+                self._journal_lines += 1
+                if ent.get("op") == "del":
+                    super().delete(ent["topic"])
+                elif ent.get("op") == "set":
+                    msg = Message.from_wire(ent["msg"])
+                    super().insert(msg.topic, msg, ent.get("expire_at"))
+
+    def _append(self, ent: dict) -> None:
+        import json
+
+        from emqx_tpu.broker.persistence import _enc
+        self._fh.write(json.dumps(ent, default=_enc) + "\n")
+        self._fh.flush()
+        self._journal_lines += 1
+        if self._journal_lines > max(64, 4 * len(self._store)):
+            self._compact()
+
+    def _compact(self) -> None:
+        import json
+        import os
+
+        from emqx_tpu.broker.persistence import _enc
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for t, (m, exp) in self._store.items():
+                f.write(json.dumps({"op": "set", "msg": m.to_wire(),
+                                    "expire_at": exp}, default=_enc) + "\n")
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._journal_lines = len(self._store)
+
+    def insert(self, topic, msg, expire_at):
+        super().insert(topic, msg, expire_at)
+        self._append({"op": "set", "msg": msg.to_wire(),
+                      "expire_at": expire_at})
+
+    def delete(self, topic):
+        if not super().delete(topic):
+            return False
+        self._append({"op": "del", "topic": topic})
+        return True
+
+    def clear(self):
+        n = super().clear()
+        self._compact()
+        return n
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def make_storage(conf) -> RetainerStorage:
+    """Config -> backend: "ram" (default) | {"type": "disc"|"disc_only",
+    "dir": path}."""
+    if isinstance(conf, RetainerStorage):
+        return conf
+    if conf in (None, "ram"):
+        return RamStorage()
+    if isinstance(conf, str):
+        conf = {"type": conf}
+    stype = conf.get("type", "ram")
+    if stype == "ram":
+        return RamStorage()
+    if stype in ("disc", "disc_only"):
+        return DiscStorage(conf.get("dir", "data/retainer"))
+    raise ValueError(f"unknown retainer storage type {stype!r}")
+
+
 class Retainer:
-    def __init__(self, node, conf: Optional[dict] = None):
+    def __init__(self, node, conf: Optional[dict] = None,
+                 storage: Optional[RetainerStorage] = None):
         self.node = node
         c = dict(node.config.get("retainer") or {})
         c.update(conf or {})
@@ -113,8 +292,9 @@ class Retainer:
         self.max_retained = int(c.get("max_retained_messages", 0))
         self.max_payload = int(c.get("max_payload_size", 1024 * 1024))
         self.default_expiry = int(c.get("msg_expiry_interval", 0))  # s, 0=∞
-        self._store: dict[str, tuple[Message, Optional[int]]] = {}
-        self._index = TopicIndex()
+        self.storage = make_storage(storage
+                                    if storage is not None
+                                    else c.get("storage"))
 
     # ---- app lifecycle ----
     def load(self) -> "Retainer":
@@ -174,24 +354,19 @@ class Retainer:
         if len(msg.payload) > self.max_payload:
             self.node.metrics.inc("messages.retained.dropped")
             return False
-        if (self.max_retained and t not in self._store
-                and len(self._store) >= self.max_retained):
+        if (self.max_retained and self.storage.get(t) is None
+                and len(self.storage) >= self.max_retained):
             self.node.metrics.inc("messages.retained.dropped")
             return False
-        if t not in self._store:
-            self._index.insert(t)
-        self._store[t] = (msg.copy(), self._expire_at(msg))
+        self.storage.insert(t, msg.copy(), self._expire_at(msg))
         self.node.metrics.inc("messages.retained")
         return True
 
     def delete(self, topic: str) -> bool:
-        if self._store.pop(topic, None) is None:
-            return False
-        self._index.delete(topic)
-        return True
+        return self.storage.delete(topic)
 
     def lookup(self, topic: str) -> Optional[Message]:
-        ent = self._store.get(topic)
+        ent = self.storage.get(topic)
         if ent is None:
             return None
         msg, exp = ent
@@ -203,7 +378,7 @@ class Retainer:
     def match(self, filt: str) -> list[Message]:
         """All live retained messages matching a filter (wildcard read)."""
         out = []
-        for t in list(self._index.match(filt)):
+        for t in self.storage.match_topics(filt):
             m = self.lookup(t)
             if m is not None:
                 out.append(m)
@@ -213,18 +388,15 @@ class Retainer:
         """Purge retained messages (all, or those matching a filter) —
         emqx_retainer:clean/0, emqx_mgmt:clean_retained."""
         if filt is None:
-            n = len(self._store)
-            self._store.clear()
-            self._index = TopicIndex()
-            return n
-        gone = list(self._index.match(filt))
+            return self.storage.clear()
+        gone = self.storage.match_topics(filt)
         for t in gone:
             self.delete(t)
         return len(gone)
 
     def clean_expired(self) -> int:
         now = now_ms()
-        stale = [t for t, (_, exp) in self._store.items()
+        stale = [t for t, _m, exp in self.storage.items()
                  if exp is not None and now > exp]
         for t in stale:
             self.delete(t)
@@ -235,7 +407,7 @@ class Retainer:
         self.clean_expired()
 
     def retained_count(self) -> int:
-        return len(self._store)
+        return len(self.storage)
 
     def stats_fun(self, stats) -> None:
-        stats.setstat("retained.count", len(self._store), "retained.max")
+        stats.setstat("retained.count", len(self.storage), "retained.max")

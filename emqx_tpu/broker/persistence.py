@@ -85,7 +85,7 @@ class Persistence:
         if retainer is not None:
             snap["retained"] = [
                 {"msg": m.to_wire(), "expire_at": exp}
-                for m, exp in retainer._store.values()]
+                for _t, m, exp in retainer.storage.items()]
         delayed = node.get_app(DelayedPublish)
         if delayed is not None:
             snap["delayed"] = [
@@ -141,8 +141,8 @@ class Persistence:
         if retainer is not None:
             for ent in snap.get("retained", []):
                 msg = Message.from_wire(ent["msg"])
-                retainer._store[msg.topic] = (msg, ent.get("expire_at"))
-                retainer._index.insert(msg.topic)
+                retainer.storage.insert(msg.topic, msg,
+                                        ent.get("expire_at"))
         delayed = node.get_app(DelayedPublish)
         if delayed is not None:
             now = int(time.time() * 1000)
@@ -161,8 +161,8 @@ class Persistence:
             retainer = node.get_app(Retainer)
             if retainer is not None:
                 msg = Message.from_wire(entry["msg"])
-                retainer._store[msg.topic] = (msg, entry.get("expire_at"))
-                retainer._index.insert(msg.topic)
+                retainer.storage.insert(msg.topic, msg,
+                                        entry.get("expire_at"))
         elif op == "retain_del":
             retainer = node.get_app(Retainer)
             if retainer is not None:

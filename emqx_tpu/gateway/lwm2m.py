@@ -171,6 +171,13 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
         self.sessions: dict[str, Lwm2mSession] = {}      # ep -> session
         self.by_location: dict[str, Lwm2mSession] = {}
         self.by_addr: dict[tuple, Lwm2mSession] = {}
+        # OMA object registry (emqx_lwm2m_xml_object_db analog): core
+        # objects compiled in, custom objects from DDF XML when configured
+        from emqx_tpu.gateway.lwm2m_objects import ObjectRegistry
+        self.objects = ObjectRegistry.core()
+        xml_dir = self.conf.get("xml_dir")
+        if xml_dir:
+            self.objects.load_xml_dir(xml_dir)
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -312,6 +319,16 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
         msg_type = cmd.get("msgType")
         data = cmd.get("data") or {}
         path = data.get("path", "")
+        try:
+            # name paths ("/Device/0/Manufacturer") resolve through the
+            # object registry; numeric paths pass through
+            path = self.objects.resolve_path(path)
+        except KeyError as e:
+            self._uplink(s, msg_type or "unknown",
+                         {"reqPath": str(data.get("path", "")),
+                          "code": "4.04", "codeMsg": str(e)},
+                         cmd.get("reqID"))
+            return
         segs = [p for p in str(path).split("/") if p != ""]
         opts = [(C.OPT_URI_PATH, seg.encode()) for seg in segs]
         token = self._next_token()
@@ -376,11 +393,15 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
             return
         if ctxt["cmd"].get("msgType") != "observe":
             s.pending.pop(token, None)
-        self._uplink(s, ctxt["cmd"].get("msgType", "resp"), {
+        data = {
             "reqPath": ctxt["path"], "code": code_str,
             "codeMsg": _code_msg(msg.code),
-            "content": _decode_content(cf, msg.payload)},
-            ctxt["cmd"].get("reqID"))
+            "content": _decode_content(cf, msg.payload)}
+        name = self.objects.path_name(ctxt["path"])
+        if name:
+            data["reqPathName"] = name   # resolved via the object registry
+        self._uplink(s, ctxt["cmd"].get("msgType", "resp"), data,
+                     ctxt["cmd"].get("reqID"))
 
 
 def _cf_bytes(cf: int) -> bytes:

@@ -289,3 +289,40 @@ class TestAdaptiveDeviceChoice:
         spiked = _ewma(cur, 30.0)           # cold-compile spike
         assert spiked < 0.02                # clamped, not dominated
         assert _ewma(None, 0.5) == 0.5
+
+
+class TestInternBounded:
+    """SURVEY §7 hard-part 3 / round-2 VERDICT weak #9: publish-side topic
+    words must NOT grow the intern table — only filter vocabulary
+    allocates ids (ops/intern.py lookup() vs intern()). An attacker
+    publishing unbounded unique topics must leave host memory bounded."""
+
+    def test_publishes_do_not_grow_intern(self):
+        node = Node()
+        b = node.broker
+        sink = Sink()
+        sid = b.register(sink, "c1")
+        b.subscribe(sid, "known/+/t", {"qos": 0})
+        eng = node.device_engine
+        # build the snapshot; record the filter-vocabulary size
+        eng.route_batch([mkmsg("known/1/t")])
+        base = len(eng.intern)
+        # a flood of unique published topics (each word never seen in a
+        # filter) routes correctly and interns NOTHING
+        for k in range(0, 5000, 50):
+            msgs = [mkmsg(f"attack/{k+i}/rnd{k+i}") for i in range(50)]
+            eng.route_batch(msgs)
+        assert len(eng.intern) == base, \
+            "publish-side words leaked into the intern table"
+        # known topics still match
+        counts = eng.route_batch([mkmsg("known/9/t")])
+        assert counts == [1]
+
+    def test_unseen_words_lookup_unknown(self):
+        from emqx_tpu.ops import intern as I
+        t = I.InternTable()
+        t.intern("level")
+        n = len(t)
+        assert t.lookup("never-seen") == I.UNKNOWN
+        assert t.lookup("also-never") == I.UNKNOWN
+        assert len(t) == n

@@ -674,3 +674,73 @@ class TestLwm2m:
             req = await dev.recv()
             assert req.code == CO.POST and req.uri_path == ["3", "0", "4"]
         run(loop, go())
+
+
+class TestLwm2mObjectRegistry:
+    """OMA object registry (round-2 VERDICT missing #3): resource
+    names/types resolvable for the core objects, name->numeric path
+    resolution, and custom-object DDF XML loading. Parity:
+    emqx_lwm2m_xml_object_db.erl + emqx_lwm2m_xml_object.erl."""
+
+    def test_device_object_resources_by_name(self):
+        from emqx_tpu.gateway.lwm2m_objects import ObjectRegistry
+        reg = ObjectRegistry.core()
+        dev = reg.object(3)
+        assert dev.name == "Device"
+        assert dev.resources[0].name == "Manufacturer"
+        assert dev.resources[0].type == "String"
+        assert dev.resources[4].operations == "E"          # Reboot
+        assert dev.resources[9].type == "Integer"          # Battery Level
+        assert dev.resources[13].type == "Time"            # Current Time
+        r = dev.resource_by_name("Battery Level")
+        assert r is not None and r.rid == 9
+
+    def test_resolve_name_paths(self):
+        from emqx_tpu.gateway.lwm2m_objects import ObjectRegistry
+        reg = ObjectRegistry.core()
+        assert reg.resolve_path("/Device/0/Manufacturer") == "/3/0/0"
+        assert reg.resolve_path("/3/0/0") == "/3/0/0"
+        assert reg.resolve_path("/LWM2M Server/1/Lifetime") == "/1/1/1"
+        assert reg.path_name("/3/0/9") == "Device/0/Battery Level"
+        with pytest.raises(KeyError):
+            reg.resolve_path("/NoSuchObject/0/x")
+        with pytest.raises(KeyError):
+            reg.resolve_path("/Device/0/NoSuchResource")
+
+    def test_decode_value_by_type(self):
+        from emqx_tpu.gateway.lwm2m_objects import ObjectRegistry
+        reg = ObjectRegistry.core()
+        assert reg.decode_value(3, 9, b"\x55") == 0x55          # Integer
+        assert reg.decode_value(3, 0, b"Acme") == "Acme"        # String
+        assert reg.decode_value(3, 9, "42") == 42
+
+    def test_load_custom_ddf_xml(self, tmp_path):
+        from emqx_tpu.gateway.lwm2m_objects import ObjectRegistry
+        xml = """<?xml version="1.0" encoding="utf-8"?>
+<LWM2M>
+  <Object ObjectType="MODefinition">
+    <Name>Temperature</Name>
+    <ObjectID>3303</ObjectID>
+    <ObjectURN>urn:oma:lwm2m:ext:3303</ObjectURN>
+    <MultipleInstances>Multiple</MultipleInstances>
+    <Resources>
+      <Item ID="5700"><Name>Sensor Value</Name>
+        <Operations>R</Operations><Type>Float</Type>
+        <MultipleInstances>Single</MultipleInstances>
+        <Mandatory>Mandatory</Mandatory></Item>
+      <Item ID="5701"><Name>Sensor Units</Name>
+        <Operations>R</Operations><Type>String</Type>
+        <MultipleInstances>Single</MultipleInstances>
+        <Mandatory>Optional</Mandatory></Item>
+    </Resources>
+  </Object>
+</LWM2M>"""
+        p = tmp_path / "3303.xml"
+        p.write_text(xml)
+        reg = ObjectRegistry.core()
+        obj = reg.load_xml(str(p))
+        assert obj.oid == 3303 and obj.multiple
+        assert reg.resolve_path("/Temperature/0/Sensor Value") \
+            == "/3303/0/5700"
+        assert reg.resource(3303, 5700).type == "Float"
+        assert reg.load_xml_dir(str(tmp_path)) == 1
