@@ -521,10 +521,41 @@ class DeviceRouteEngine:
         self._outstanding += 1
         return h
 
+    # ---- device-side tracing (SURVEY §5.1 mapping) -------------------
+    def start_device_trace(self, log_dir: str) -> bool:
+        """Begin a jax.profiler trace capturing the device-side route
+        steps (each dispatch is annotated as one profiler step, so the
+        trace decomposes device execution from host/relay time). Returns
+        False when the backend has no profiler support."""
+        import jax
+        try:
+            jax.profiler.start_trace(log_dir)
+            self._tracing = True
+            return True
+        except Exception:  # noqa: BLE001 — relay backends may lack it
+            return False
+
+    def stop_device_trace(self) -> None:
+        import jax
+        if getattr(self, "_tracing", False):
+            self._tracing = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+
     def dispatch(self, h) -> None:
         """Stage 2 (executor thread): run the jitted route step. On a
         dispatch relay this blocks on HTTP; on co-located hardware it is an
-        async enqueue — either way it is off the event loop."""
+        async enqueue — either way it is off the event loop. Under an
+        active jax.profiler trace every dispatch is one annotated step."""
+        import jax
+        self._step_num = getattr(self, "_step_num", 0) + 1
+        with jax.profiler.StepTraceAnnotation("route_step",
+                                              step_num=self._step_num):
+            self._dispatch_inner(h)
+
+    def _dispatch_inner(self, h) -> None:
         from emqx_tpu.models.router_engine import (route_step,
                                                    route_step_shapes)
         from emqx_tpu.ops.shared import (STRATEGIES, STRATEGY_HASH_CLIENT,

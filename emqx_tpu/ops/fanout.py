@@ -37,7 +37,8 @@ class SubTable(NamedTuple):
 
     sub_start: jax.Array
     sub_row: jax.Array
-    sub_opts: jax.Array
+    sub_opts: jax.Array           # int8: packed subopts fit 6 bits
+
     fs_start: jax.Array
     fs_slot: jax.Array
     shared_start: jax.Array
@@ -92,7 +93,8 @@ def fanout_normal(table: SubTable, matches: jax.Array, *,
     """
     rows, idx, counts, overflow = _segment_expand(
         table.sub_start, table.sub_row, matches, fanout_cap)
-    opts = jnp.where(idx >= 0, table.sub_opts[jnp.clip(idx, 0)], 0)
+    opts = jnp.where(idx >= 0, table.sub_opts[jnp.clip(idx, 0)],
+                     jnp.int8(0))
     return FanoutResult(rows=rows, opts=opts, counts=counts, overflow=overflow)
 
 
@@ -139,6 +141,10 @@ def build_subtable(filter_cap: int,
                   1 + int(fs_slot.max(initial=-1)))
     shared_start, shared_row, shared_opts = _csr(n_slots, shared_members,
                                                  member_rows_cap)
+    # packed subopts fit 6 bits: an int8 plane quarters the HBM traffic of
+    # the opts gathers + outputs (round-2 VERDICT perf item)
+    sub_opts = sub_opts.astype(np.int8)
+    shared_opts = shared_opts.astype(np.int8)
     return SubTable(sub_start=sub_start, sub_row=sub_row, sub_opts=sub_opts,
                     fs_start=fs_start, fs_slot=fs_slot,
                     shared_start=shared_start, shared_row=shared_row,

@@ -46,12 +46,18 @@ class SharedPickResult(NamedTuple):
                             # and rebase cursors consistently)
 
 
-def _rank_over_runs(sids: jax.Array) -> jax.Array:
-    """rank[b,k] = #occurrences of sids[b,k] earlier in flattened batch order.
+def _rank_and_occur(sids: jax.Array, n_slots: int):
+    """rank[b,k] = #occurrences of sids[b,k] earlier in flattened batch
+    order; occur[g] = occurrences of slot g in the batch.
 
     -1 entries get rank 0 (unused). Stable sort keeps batch order within
-    runs; run starts are recovered by scatter (XLA's native accumulate scans
-    are too slow on TPU — see ops.scan_ops).
+    runs; run starts are recovered by scatter (XLA's native accumulate
+    scans are too slow on TPU — see ops.scan_ops). Every scatter here has
+    provably unique live indices (one per run / a permutation), so
+    unique_indices=True keeps XLA off the serialized non-unique scatter
+    path; `occur` is derived from run ends (last rank + 1) instead of a
+    non-unique scatter-add over the whole batch (round-2: that add was
+    the fused step's dominant cost candidate).
     """
     from emqx_tpu.ops.scan_ops import cumsum_blocked
 
@@ -62,13 +68,21 @@ def _rank_over_runs(sids: jax.Array) -> jax.Array:
     sorted_sids = flat[order]
     is_start = jnp.concatenate(
         [jnp.ones(1, bool), sorted_sids[1:] != sorted_sids[:-1]])
+    is_end = jnp.concatenate(
+        [sorted_sids[1:] != sorted_sids[:-1], jnp.ones(1, bool)])
     pos = jnp.arange(n, dtype=jnp.int32)
     run_id = cumsum_blocked(is_start.astype(jnp.int32)) - 1
     starts = jnp.zeros(n, jnp.int32).at[
-        jnp.where(is_start, run_id, n)].set(pos, mode="drop")
+        jnp.where(is_start, run_id, n)].set(pos, mode="drop",
+                                            unique_indices=True)
     rank_sorted = pos - starts[run_id]
-    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
-    return rank.reshape(B, K)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted,
+                                                 unique_indices=True)
+    # occur: at each run END the rank is (count-1); one unique scatter
+    occur = jnp.zeros(n_slots, jnp.int32).at[
+        jnp.where(is_end & (sorted_sids >= 0), sorted_sids, n_slots)
+    ].set(rank_sorted + 1, mode="drop", unique_indices=True)
+    return rank.reshape(B, K), occur
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -89,7 +103,7 @@ def pick_members(table: SubTable, cursors: jax.Array, sids: jax.Array,
     size = table.shared_start[safe + 1] - lo  # [B, K] members per slot
     nonempty = valid & (size > 0)
 
-    rank = _rank_over_runs(sids)
+    rank, occur = _rank_and_occur(sids, cursors.shape[0])
     base_rr = cursors[safe] + rank
     base_hash = (msg_hash[:, None].astype(jnp.uint32)
                  * jnp.uint32(0x9E3779B1) ^ safe.astype(jnp.uint32)).astype(jnp.int32)
@@ -98,12 +112,11 @@ def pick_members(table: SubTable, cursors: jax.Array, sids: jax.Array,
     member = jnp.where(nonempty, base % jnp.maximum(size, 1), 0)
     idx = lo + member
     rows = jnp.where(nonempty, table.shared_row[jnp.clip(idx, 0)], -1)
-    opts = jnp.where(nonempty, table.shared_opts[jnp.clip(idx, 0)], 0)
+    opts = jnp.where(nonempty, table.shared_opts[jnp.clip(idx, 0)],
+                     jnp.zeros((), table.shared_opts.dtype))
 
     # advance cursors by per-slot occurrence counts (round_robin only)
-    occur = jnp.zeros_like(cursors).at[safe.reshape(-1)].add(
-        valid.reshape(-1).astype(cursors.dtype), mode="drop")
     new_cursors = jnp.where(strategy == STRATEGY_ROUND_ROBIN,
-                            cursors + occur, cursors)
+                            cursors + occur.astype(cursors.dtype), cursors)
     return SharedPickResult(rows=rows, opts=opts, new_cursors=new_cursors,
-                            occur=occur)
+                            occur=occur.astype(cursors.dtype))

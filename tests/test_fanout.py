@@ -151,3 +151,34 @@ class TestRouteStep:
         assert int(res.shared_rows[2, 0]) == 51
         assert list(np.asarray(res.new_cursors)) == [2]
         assert not bool(res.overflow.any())
+
+
+class TestRankOccurOracle:
+    """Randomized oracle for the sort-based rank/occur kernel (rewritten
+    round-3 with unique-index scatters): rank must equal the number of
+    earlier occurrences in flattened batch order, occur the per-slot
+    totals — the invariants round-robin fairness rests on."""
+
+    def test_matches_bruteforce(self):
+        import numpy as np
+
+        from emqx_tpu.ops.shared import _rank_and_occur
+        rng = np.random.RandomState(3)
+        for _ in range(5):
+            B, K, G = 64, 3, 17
+            sids = rng.randint(-1, G, size=(B, K)).astype(np.int32)
+            rank, occur = _rank_and_occur(sids, G)
+            rank = np.asarray(rank)
+            occur = np.asarray(occur)
+            flat = sids.reshape(-1)
+            seen: dict = {}
+            want_rank = np.zeros_like(flat)
+            for i, s in enumerate(flat):
+                if s < 0:
+                    continue
+                want_rank[i] = seen.get(int(s), 0)
+                seen[int(s)] = want_rank[i] + 1
+            assert (rank.reshape(-1)[flat >= 0]
+                    == want_rank[flat >= 0]).all()
+            want_occur = np.bincount(flat[flat >= 0], minlength=G)
+            assert (occur == want_occur).all()

@@ -38,7 +38,7 @@ def main():
     from emqx_tpu.ops.fanout import (SubTable, fanout_normal, shared_slots)
     from emqx_tpu.ops.shapes import build_shape_tables, shape_match
     from emqx_tpu.ops.shared import (STRATEGY_ROUND_ROBIN, pick_members,
-                                     _rank_over_runs)
+                                     _rank_and_occur)
 
     log(f"profile: subs={subs} B={B} window={window} dev={jax.devices()[0]}")
 
@@ -66,7 +66,7 @@ def main():
     n_shared_filters = F * shared_pct // 100
     sub_start = np.arange(F + 1, dtype=np.int32)
     sub_row = np.arange(F, dtype=np.int32)
-    sub_opts = np.ones(F, np.int32)
+    sub_opts = np.ones(F, np.int8)
     group_of = np.arange(n_shared_filters, dtype=np.int32) // 16
     n_groups = max(1, int(group_of.max(initial=0)) + 1)
     fs_start = np.zeros(F + 1, np.int32)
@@ -75,7 +75,7 @@ def main():
     fs_slot = group_of if n_shared_filters else np.full(1, -1, np.int32)
     shared_start = np.arange(n_groups + 1, dtype=np.int32) * 8
     shared_row = F + np.arange(n_groups * 8, dtype=np.int32)
-    shared_opts_a = np.ones(n_groups * 8, np.int32)
+    shared_opts_a = np.ones(n_groups * 8, np.int8)
     subs_tbl = SubTable(sub_start, sub_row, sub_opts, fs_start, fs_slot,
                         shared_start, shared_row, shared_opts_a)
     tables = put_tree_chunked(ShapeRouterTables(shapes=shapes, subs=subs_tbl))
@@ -153,14 +153,15 @@ def main():
         return (acc + sp.rows.sum(dtype=jnp.int32)
                 + sp.new_cursors.sum(dtype=jnp.int32))
 
-    # 4b. rank_over_runs alone (the argsort) on a [B, SLOT_CAP] input
+    # 4b. rank+occur alone (the argsort + unique scatters)
     @jax.jit
     def f_rank(acc, batch):
         t, l, d, h = batch
         sids = jnp.stack([h % np.int32(n_groups),
                           jnp.full((B,), -1, jnp.int32)], axis=1)
-        rank = _rank_over_runs(sids)
-        return acc + rank.sum(dtype=jnp.int32)
+        rank, occur = _rank_and_occur(sids, n_groups)
+        return (acc + rank.sum(dtype=jnp.int32)
+                + occur.sum(dtype=jnp.int32))
 
     # 4c. occur scatter-add alone
     @jax.jit
