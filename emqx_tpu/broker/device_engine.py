@@ -224,6 +224,15 @@ class DeviceRouteEngine:
         result = self._build_from_capture(capture)
         self._apply_build(result, journal=())
 
+    def _group_local(self, real: str, group: str) -> bool:
+        """Standalone: every group is locally homed. Under a cluster only
+        groups with no live remote members keep the on-device pick
+        (round-2 weak #10: config-5-shaped deployments previously lost
+        the whole device P8 path)."""
+        cluster = self.broker.cluster
+        return cluster is None or cluster.group_is_local(self.broker, real,
+                                                         group)
+
     def _capture_state_sync(self):
         """Point-in-time copy of the routing state (sync, may stall)."""
         broker, router = self.broker, self.router
@@ -232,7 +241,8 @@ class DeviceRouteEngine:
         subs = {f: list(broker.subs[f].items())
                 for f in filters if broker.subs.get(f)}
         shared = {f: {g: (list(grp.members.items()), grp.cursor)
-                      for g, grp in broker.shared[f].items()}
+                      for g, grp in broker.shared[f].items()
+                      if self._group_local(f, g)}
                   for f in filters if broker.shared.get(f)}
         return exact, wild, subs, shared
 
@@ -257,7 +267,8 @@ class DeviceRouteEngine:
                 g = broker.shared.get(f)
                 if g:
                     shared[f] = {gn: (list(grp.members.items()), grp.cursor)
-                                 for gn, grp in g.items()}
+                                 for gn, grp in g.items()
+                                 if self._group_local(f, gn)}
             await asyncio.sleep(0)
         return exact, wild, subs, shared
 
@@ -496,9 +507,24 @@ class DeviceRouteEngine:
 
     # ---- the serving path ----------------------------------------------
     def device_shared_active(self) -> bool:
+        """Device picks serve all device-supported strategies; under a
+        cluster the snapshot holds only locally-homed groups, and groups
+        with remote members dispatch cluster-wide at consume time
+        (round-2 weak #10 — previously ANY cluster disabled the whole
+        on-device shared path)."""
         from emqx_tpu.ops.shared import STRATEGIES
-        return (self.broker.cluster is None
-                and self.broker.shared_strategy in STRATEGIES)
+        return self.broker.shared_strategy in STRATEGIES
+
+    def _host_shared_dispatch(self, f: str, gname: str, msg) -> bool:
+        """One group's host-side dispatch: cluster-wide pick under a
+        cluster, local strategy pick standalone."""
+        broker = self.broker
+        if broker.cluster is not None:
+            return broker.cluster._dispatch_one_group(broker, f, gname,
+                                                      msg)
+        g = broker.shared.get(f, {}).get(gname)
+        return bool(g and g.members
+                    and broker._shared_pick_deliver(gname, f, g, msg))
 
     def prepare(self, msgs: list[Message]):
         """Stage 1 (event loop): encode a micro-batch for dispatch.
@@ -738,9 +764,7 @@ class DeviceRouteEngine:
                 f, gname = b.slot_key[slot]
                 handled.add((f, gname))
                 if (f, gname) in self.dirty_slots:
-                    g = broker.shared.get(f, {}).get(gname)
-                    if g is not None and g.members and \
-                            broker._shared_pick_deliver(gname, f, g, msg):
+                    if self._host_shared_dispatch(f, gname, msg):
                         n += 1
                     continue
                 sid = int(sr_row[k])
@@ -749,20 +773,31 @@ class DeviceRouteEngine:
                         dict(_unpack_opts(int(so_row[k])), share=gname)):
                     n += 1
                     metrics.inc("messages.routed.device")
-            # groups created after the snapshot on matched filters
+            cluster = broker.cluster
             for f in matched:
+                # groups created after the snapshot on matched filters
                 for gname in self.new_slots_by_filter.get(f, ()):
                     if (f, gname) in handled:
                         continue
-                    g = broker.shared.get(f, {}).get(gname)
-                    if g is not None and g.members and \
-                            broker._shared_pick_deliver(gname, f, g, msg):
+                    handled.add((f, gname))
+                    if self._host_shared_dispatch(f, gname, msg):
                         n += 1
                 # delta filters' groups (host dispatch covers them all)
                 if f in self._delta_fid_of:
-                    for gname, g in broker.shared.get(f, {}).items():
-                        if (f, gname) not in handled and g.members and \
-                                broker._shared_pick_deliver(gname, f, g, msg):
+                    for gname in list(broker.shared.get(f, {})):
+                        if (f, gname) not in handled:
+                            handled.add((f, gname))
+                            if self._host_shared_dispatch(f, gname, msg):
+                                n += 1
+                if cluster is not None:
+                    # groups excluded from the snapshot (remote members)
+                    # and remote-only groups known via replication
+                    for gname in set(broker.shared.get(f, ())) \
+                            | cluster._groups_by_real.get(f, set()):
+                        if (f, gname) in handled:
+                            continue
+                        handled.add((f, gname))
+                        if self._host_shared_dispatch(f, gname, msg):
                             n += 1
         else:
             n += broker._dispatch_shared(msg, matched)

@@ -220,6 +220,12 @@ class ClusterNode:
                     del self._groups_by_real[real]
         if origin == self.rpc.node:
             return
+        # a REMOTE membership change can flip a group between
+        # locally-homed (on-device pick) and cluster-wide (host pick):
+        # the device snapshot must mark the slot stale either way
+        engine = getattr(self.node.broker, "device_engine", None)
+        if engine is not None:
+            engine.note_member_change(real, group)
         if op == "add":
             self.node.broker.router.add_route(real)
         else:
@@ -281,6 +287,15 @@ class ClusterNode:
                 if self._dispatch_one_group(broker, real, group, msg):
                     n += 1
         return n
+
+    def group_is_local(self, broker, real: str, group: str) -> bool:
+        """True when every live replicated member of (real, group) is on
+        this node — such groups can keep the on-device pick path (the
+        device snapshot holds exactly the local members)."""
+        me = self.rpc.node
+        return all(o == me for o, _sid in
+                   self.store.table(T_SHARED).lookup((real, group))
+                   if self.membership.is_running(o))
 
     def _members(self, broker, real: str, group: str) -> list[tuple[str, int]]:
         out = {(o, v) for o, v in

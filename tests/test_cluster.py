@@ -510,3 +510,56 @@ async def _test_mgmt_cluster_fanout():
                    and r["node"] == ["n1@127.0.0.1"] for r in routes)
     finally:
         await teardown(clusters)
+
+
+def test_device_shared_picks_for_local_groups_under_cluster(loop):
+    run(loop, _test_device_shared_local_groups())
+
+
+async def _test_device_shared_local_groups():
+    """Round-2 weak #10: a cluster no longer disables the on-device
+    shared-sub path wholesale — locally-homed groups keep device picks,
+    groups with remote members dispatch cluster-wide, and a remote join
+    flips a group from device to cluster dispatch without losing
+    single-delivery semantics."""
+    nodes, clusters = [], []
+    for i in range(2):
+        # device path ON (unlike the other cluster tests)
+        node = Node(use_device=(i == 0), name=f"d{i}@127.0.0.1")
+        cn = ClusterNode(node, port=0, heartbeat_s=0.05)
+        await cn.start()
+        nodes.append(node)
+        clusters.append(cn)
+    await clusters[1].join(*clusters[0].address)
+    try:
+        b0, b1 = nodes[0].broker, nodes[1].broker
+        eng = nodes[0].device_engine
+        assert eng is not None and eng.device_shared_active()
+        la, lb = Capture(), Capture()
+        b0.subscribe(b0.register(la, "la"), "$share/loc/work/+")
+        b0.subscribe(b0.register(lb, "lb"), "$share/loc/work/+")
+        await settle(clusters)
+        from emqx_tpu.broker.message import make
+        # batch through the device engine: the group is locally homed, so
+        # picks come from the device snapshot
+        msgs = [make("p", 0, f"work/{i}", b"x") for i in range(8)]
+        counts = eng.route_batch(msgs)
+        assert counts == [1] * 8
+        assert len(la.msgs) + len(lb.msgs) == 8
+        assert len(la.msgs) == 4 and len(lb.msgs) == 4  # round robin
+        assert nodes[0].metrics.val("messages.routed.device") >= 8
+
+        # a remote member joins: the group must flip to cluster-wide
+        rc = Capture()
+        b1.subscribe(b1.register(rc, "rc"), "$share/loc/work/+")
+        await settle(clusters)
+        assert not clusters[0].group_is_local(b0, "work/+", "loc")
+        before = len(la.msgs) + len(lb.msgs)
+        msgs = [make("p", 0, f"work/x{i}", b"y") for i in range(9)]
+        counts = eng.route_batch(msgs)
+        await settle(clusters)
+        total = (len(la.msgs) + len(lb.msgs) - before) + len(rc.msgs)
+        assert total == 9, "single delivery violated after remote join"
+        assert len(rc.msgs) >= 1, "remote member never picked"
+    finally:
+        await teardown(clusters)
