@@ -159,15 +159,18 @@ class TestRankOccurOracle:
     earlier occurrences in flattened batch order, occur the per-slot
     totals — the invariants round-robin fairness rests on."""
 
-    def test_matches_bruteforce(self):
+    @pytest.mark.parametrize("impl", ["sorted", "blocked"])
+    def test_matches_bruteforce(self, impl):
         import numpy as np
 
-        from emqx_tpu.ops.shared import _rank_and_occur
+        from emqx_tpu.ops import shared as S
+        fn = (S._rank_and_occur_sorted if impl == "sorted"
+              else S._rank_and_occur_blocked)
         rng = np.random.RandomState(3)
         for _ in range(5):
             B, K, G = 64, 3, 17
             sids = rng.randint(-1, G, size=(B, K)).astype(np.int32)
-            rank, occur = _rank_and_occur(sids, G)
+            rank, occur = fn(sids, G)
             rank = np.asarray(rank)
             occur = np.asarray(occur)
             flat = sids.reshape(-1)
