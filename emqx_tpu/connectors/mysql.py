@@ -123,11 +123,54 @@ def _decode_binary_row(pkt: bytes, ncols: int,
         elif t == 0x05:                           # DOUBLE
             row.append(repr(struct.unpack_from("<d", pkt, pos)[0]))
             pos += 8
+        elif t in (0x07, 0x0A, 0x0C):             # TIMESTAMP/DATE/DATETIME
+            n = pkt[pos]
+            pos += 1
+            v, pos = _decode_bin_datetime(pkt, pos, n, date_only=(t == 0x0A))
+            row.append(v)
+        elif t == 0x0B:                           # TIME
+            n = pkt[pos]
+            pos += 1
+            v, pos = _decode_bin_time(pkt, pos, n)
+            row.append(v)
         else:                                     # lenenc (strings/blobs/
-            n, pos = _lenenc(pkt, pos)            #  decimals/json/dates)
+            n, pos = _lenenc(pkt, pos)            #  decimals/json)
             row.append(pkt[pos:pos + (n or 0)].decode("utf-8", "replace"))
             pos += n or 0
     return row
+
+
+def _decode_bin_datetime(pkt: bytes, pos: int, n: int,
+                         date_only: bool) -> tuple[str, int]:
+    """Binary DATE/DATETIME/TIMESTAMP payload (length n in 0/4/7/11) ->
+    the text-protocol rendering, so prepared and text paths agree."""
+    y = mo = d = h = mi = s = us = 0
+    if n >= 4:
+        y, mo, d = struct.unpack_from("<HBB", pkt, pos)
+    if n >= 7:
+        h, mi, s = struct.unpack_from("<BBB", pkt, pos + 4)
+    if n >= 11:
+        us = struct.unpack_from("<I", pkt, pos + 7)[0]
+    if date_only:
+        out = f"{y:04d}-{mo:02d}-{d:02d}"
+    else:
+        out = f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}"
+        if us:
+            out += f".{us:06d}"
+    return out, pos + n
+
+
+def _decode_bin_time(pkt: bytes, pos: int, n: int) -> tuple[str, int]:
+    """Binary TIME payload (length n in 0/8/12): sign, days, h:m:s[.us]."""
+    neg = days = h = mi = s = us = 0
+    if n >= 8:
+        neg, days, h, mi, s = struct.unpack_from("<BIBBB", pkt, pos)
+    if n >= 12:
+        us = struct.unpack_from("<I", pkt, pos + 8)[0]
+    out = f"{'-' if neg else ''}{days * 24 + h:02d}:{mi:02d}:{s:02d}"
+    if us:
+        out += f".{us:06d}"
+    return out, pos + n
 
 
 def escape(value: Any) -> str:
@@ -398,46 +441,51 @@ class MysqlClient:
         if first[:1] == b"\xff":
             raise self._err(first)
         stmt_id, n_cols, n_params = struct.unpack_from("<IHH", first, 1)
-        if n_params != len(params):
-            raise ValueError(f"query expects {n_params} params, "
-                             f"got {len(params)}")
-        if n_params:
-            await self._read_columns(n_params)         # param definitions
-        if n_cols:
-            await self._read_columns(n_cols)           # result columns
-
-        # COM_STMT_EXECUTE: null bitmap + new-params flag + types + values
-        null_bits = bytearray((len(params) + 7) // 8)
-        types = b""
-        values = b""
-        for i, v in enumerate(params):
-            if v is None:
-                null_bits[i // 8] |= 1 << (i % 8)
-                types += struct.pack("<H", 0x06)       # MYSQL_TYPE_NULL
-                continue
-            if isinstance(v, bool):
-                v = int(v)
-            if isinstance(v, int):
-                types += struct.pack("<H", 0x08)       # LONGLONG (signed)
-                values += struct.pack("<q", v)
-            elif isinstance(v, float):
-                types += struct.pack("<H", 0x05)       # DOUBLE
-                values += struct.pack("<d", v)
-            else:
-                vb = v if isinstance(v, (bytes, bytearray)) \
-                    else str(v).encode()
-                types += struct.pack("<H", 0xFD)       # VAR_STRING
-                values += _enc_lenenc(bytes(vb))
-        body = (b"\x17" + struct.pack("<IBI", stmt_id, 0, 1)
-                + bytes(null_bits) + b"\x01" + types + values)
-        self._seq = 0
-        self._write_packet(body)
-        await self._w.drain()
-
-        first = await self._read_packet()
-        if first[:1] == b"\xff":
-            raise self._err(first)
+        # everything past a successful PREPARE runs under the CLOSE
+        # guard: an error mid-flow must neither leak the server-side
+        # statement nor leave unread definition packets that would
+        # desynchronize the next query on this pooled connection
         try:
+            if n_params:
+                await self._read_columns(n_params)     # param definitions
+            if n_cols:
+                await self._read_columns(n_cols)       # result columns
+            if n_params != len(params):
+                raise ValueError(f"query expects {n_params} params, "
+                                 f"got {len(params)}")
+
+            # COM_STMT_EXECUTE: null bitmap + new-params flag + types +
+            # values
+            null_bits = bytearray((len(params) + 7) // 8)
+            types = b""
+            values = b""
+            for i, v in enumerate(params):
+                if v is None:
+                    null_bits[i // 8] |= 1 << (i % 8)
+                    types += struct.pack("<H", 0x06)   # MYSQL_TYPE_NULL
+                    continue
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, int):
+                    types += struct.pack("<H", 0x08)   # LONGLONG (signed)
+                    values += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += struct.pack("<H", 0x05)   # DOUBLE
+                    values += struct.pack("<d", v)
+                else:
+                    vb = v if isinstance(v, (bytes, bytearray)) \
+                        else str(v).encode()
+                    types += struct.pack("<H", 0xFD)   # VAR_STRING
+                    values += _enc_lenenc(bytes(vb))
+            body = (b"\x17" + struct.pack("<IBI", stmt_id, 0, 1)
+                    + bytes(null_bits) + b"\x01" + types + values)
+            self._seq = 0
+            self._write_packet(body)
+            await self._w.drain()
+
+            first = await self._read_packet()
+            if first[:1] == b"\xff":
+                raise self._err(first)
             if first[:1] == b"\x00":               # OK: no resultset
                 return [], []
             ncols, _ = _lenenc(first, 0)

@@ -134,6 +134,7 @@ class SentinelRedisClient(RedisClient):
         for host, port in self.sentinels:
             s = RedisClient(host=host, port=port,
                             password=self.sentinel_password,
+                            ssl=self.ssl,
                             connect_timeout=self.connect_timeout)
             try:
                 await s.connect()

@@ -267,7 +267,11 @@ class QuicConnectionBase:
         """Client side of address validation (RFC 9000 §8.1.2): adopt the
         server's new CID + token, re-derive Initial keys, and re-send the
         Initial flight. At most one Retry per connection is honored."""
-        if self._saw_retry or 2 in self.keys_rx:
+        # RFC 9000 §17.2.5.2: discard Retry once ANY server packet was
+        # processed — handshake keys install on the ServerHello in the
+        # server's Initial, so gate on level 1, not 1-RTT (the Retry tag
+        # key is public; a mid-handshake injected Retry must not reset us)
+        if self._saw_retry or 1 in self.keys_rx:
             return
         parsed = P.decode_retry(datagram, self.dcid)
         if parsed is None:

@@ -859,3 +859,49 @@ class TestLdapAuthn:
             assert v == "ok"
             await srv.stop()
         run(loop, go())
+
+
+class TestMysqlPreparedEdges:
+    """Review follow-ups: error paths must not desynchronize a pooled
+    connection, and binary temporal values must match the text path."""
+
+    def test_param_mismatch_leaves_connection_usable(self, loop):
+        def handler(sql, params=None):
+            return (["a"], [["1"]])
+
+        async def go():
+            srv = await FakeMysql(handler=handler).start()
+            c = MysqlClient(port=srv.port)
+            await c.connect()
+            with pytest.raises(ValueError):
+                await c.query("SELECT a FROM t WHERE x = ? AND y = ?",
+                              ["only-one", "two", "three"])
+            # the connection must still serve the next query correctly
+            cols, rows = await c.query("SELECT a FROM t WHERE x = ?",
+                                       ["v"])
+            assert (cols, rows) == (["a"], [["1"]])
+            assert await c.ping()
+            await c.close()
+            await srv.stop()
+        run(loop, go())
+
+    def test_binary_temporal_decode(self):
+        import struct
+
+        from emqx_tpu.connectors.mysql import (_decode_bin_datetime,
+                                               _decode_bin_time)
+        # DATETIME 2026-07-30 12:34:56.000789
+        payload = struct.pack("<HBBBBBI", 2026, 7, 30, 12, 34, 56, 789)
+        v, pos = _decode_bin_datetime(payload, 0, 11, date_only=False)
+        assert v == "2026-07-30 12:34:56.000789" and pos == 11
+        # DATE only
+        v, _ = _decode_bin_datetime(struct.pack("<HBB", 2026, 1, 2), 0, 4,
+                                    date_only=True)
+        assert v == "2026-01-02"
+        # zero-length = zero value
+        v, _ = _decode_bin_datetime(b"", 0, 0, date_only=False)
+        assert v == "0000-00-00 00:00:00"
+        # TIME -26:10:05 (1 day + 2h)
+        t = struct.pack("<BIBBB", 1, 1, 2, 10, 5)
+        v, _ = _decode_bin_time(t, 0, 8)
+        assert v == "-26:10:05"
