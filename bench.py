@@ -475,16 +475,18 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
                 if k % 64 == 63:
                     await asyncio.sleep(0)   # let the batcher drain
 
-        await asyncio.gather(*[flood(cl, 100 + c)
-                               for c, cl in enumerate(pubs)])
-        hb.cancel()
-        # drain: wait until all deliveries arrive (bounded)
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            got = sum(cl.messages.qsize() for cl in subs)
-            if got >= total:
-                break
-            await asyncio.sleep(0.05)
+        try:
+            await asyncio.gather(*[flood(cl, 100 + c)
+                                   for c, cl in enumerate(pubs)])
+            # drain: wait until all deliveries arrive (bounded)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                got = sum(cl.messages.qsize() for cl in subs)
+                if got >= total:
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            hb.cancel()
         dt = time.time() - t0
         delivered = sum(cl.messages.qsize() for cl in subs)
         for cl in pubs + subs:

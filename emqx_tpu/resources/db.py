@@ -56,6 +56,14 @@ class RedisResource(_PooledDbResource):
 
     def _make_client(self) -> RedisClient:
         c = self.conf
+        if c.get("redis_type") == "cluster" or c.get("cluster_nodes"):
+            # emqx_connector_redis.erl cluster mode: servers seed the
+            # slot-routed cluster client (eredis_cluster)
+            from emqx_tpu.connectors.redis import ClusterRedisClient
+            return ClusterRedisClient(
+                startup_nodes=[tuple(s) for s in c.get("cluster_nodes", [])],
+                username=c.get("username"), password=c.get("password"),
+                ssl=c.get("ssl"))
         if c.get("redis_type") == "sentinel" or c.get("sentinels"):
             # emqx_connector_redis.erl sentinel mode: servers are the
             # sentinels, `sentinel` names the master set

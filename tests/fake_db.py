@@ -69,7 +69,14 @@ class FakeRedis(_FakeServer):
         # SENTINEL get-master-addr-by-name
         self.masters = masters
         self.hashes: dict[str, dict[str, str]] = {}
+        self.kv: dict[str, str] = {}
         self.commands: list[list[bytes]] = []
+        # cluster mode: list of (start, end, host, port) for CLUSTER SLOTS
+        self.cluster_slots: Optional[list] = None
+        # key -> ("MOVED"|"ASK", slot, host, port): forced redirects
+        self.redirects: dict[str, tuple] = {}
+        # keys mid-migration on THIS node: served only after ASKING
+        self.ask_required: set[str] = set()
 
     async def _read_cmd(self, reader) -> Optional[list[bytes]]:
         line = (await reader.readuntil(b"\r\n"))[:-2]
@@ -122,6 +129,9 @@ class FakeRedis(_FakeServer):
                 else:
                     writer.write(b"*2\r\n" + self._bulk(str(m[0]))
                                  + self._bulk(str(m[1])))
+            elif cmd in (b"HGETALL", b"HMGET") \
+                    and self._redirect(args, writer):
+                pass
             elif cmd == b"HGETALL":
                 h = self.hashes.get(args[1].decode(), {})
                 out = [b"*%d\r\n" % (len(h) * 2)]
@@ -136,11 +146,46 @@ class FakeRedis(_FakeServer):
                 for f in fields:
                     out.append(self._bulk(h.get(f)))
                 writer.write(b"".join(out))
+            elif cmd == b"CLUSTER" and len(args) > 1 \
+                    and args[1].upper() == b"SLOTS":
+                entries = self.cluster_slots or []
+                out = [b"*%d\r\n" % len(entries)]
+                for start, end, host, port in entries:
+                    out.append(b"*3\r\n:%d\r\n:%d\r\n" % (start, end))
+                    out.append(b"*3\r\n" + self._bulk(host)
+                               + b":%d\r\n" % port + self._bulk("nodeid"))
+                writer.write(b"".join(out))
+            elif cmd == b"ASKING":
+                self._asking = True
+                writer.write(b"+OK\r\n")
+            elif cmd in (b"GET", b"SET") and self._redirect(args, writer):
+                pass
             elif cmd == b"GET":
-                writer.write(self._bulk(None))
+                writer.write(self._bulk(self.kv.get(args[1].decode())))
+            elif cmd == b"SET":
+                self.kv[args[1].decode()] = args[2].decode()
+                writer.write(b"+OK\r\n")
             else:
                 writer.write(b"-ERR unknown command\r\n")
             await writer.drain()
+
+    def _redirect(self, args, writer) -> bool:
+        """Write a forced MOVED/ASK redirect for this key (cluster tests);
+        a key mid-import here is served only under a one-shot ASKING."""
+        key = args[1].decode()
+        if key in self.ask_required:
+            if getattr(self, "_asking", False):
+                self._asking = False
+                return False
+            writer.write(b"-TRYAGAIN key is being imported (no ASKING)\r\n")
+            return True
+        r = self.redirects.get(key)
+        if r is None:
+            return False
+        kind, slot, host, port = r
+        writer.write(b"-%s %d %s:%d\r\n" % (kind.encode(), slot,
+                                            host.encode(), port))
+        return True
 
 
 def _mysql_scramble(password: bytes, nonce: bytes) -> bytes:
