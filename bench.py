@@ -287,6 +287,8 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     # jitted program as the route step (a separate digest dispatch per
     # iteration doubled the relay's per-call overhead in round 2's first
     # measurement)
+    from emqx_tpu.models.router_engine import route_digest
+
     @jax.jit
     def step_digest(tb, cur, acc, topics, lens_, dollar, hashes):
         # tables MUST be an argument: closing over them would bake 200MB
@@ -294,35 +296,60 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         r = route_step_shapes(tb, cur, topics, lens_, dollar, hashes,
                               strat, fanout_cap=FAN_CAP,
                               slot_cap=SLOT_CAP)
-        d = (acc + r.rows.sum(dtype=jnp.int32)
-             + r.fan_counts.sum(dtype=jnp.int32)
-             + r.shared_rows.sum(dtype=jnp.int32)
-             + r.match_counts.sum(dtype=jnp.int32)
-             + r.opts.sum(dtype=jnp.int32))
-        return r.new_cursors, d
+        return r.new_cursors, acc + route_digest(r)
+
+    # W-fused window: ONE dispatch routes W whole batches (lax.scan inside
+    # the jitted program, models/router_engine.route_window_shapes). The
+    # per-call dispatch floor — visible in round 2 as the gap between the
+    # match fold's arithmetic rate and the match-only call rate — is paid
+    # once per W batches. Oracle-tested bit-identical to sequential steps.
+    from emqx_tpu.models.router_engine import route_window_shapes
+
+    FUSE = max(1, min(int(os.environ.get("BENCH_FUSE", 8)), len(staged),
+                      window))
+    if window % FUSE:
+        log(f"window {window} rounded to {window - window % FUSE} "
+            f"(multiple of fuse={FUSE})")
+    stacked = tuple(jnp.stack([staged[k][i] for k in range(FUSE)])
+                    for i in range(4))
+
+    @jax.jit
+    def window_digest(tb, cur, acc, topics, lens_, dollar, hashes):
+        new_cur, digests = route_window_shapes(
+            tb, cur, topics, lens_, dollar, hashes, strat,
+            fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
+        return new_cur, acc + digests.sum(dtype=jnp.int32)
 
     def run_window(n):
         cur = cursors0
         acc = _put_retry(np.int32(0))
         t0 = time.time()
-        for i in range(n):
-            cur, acc = step_digest(tables, cur, acc, *staged[i % 8])
+        for _ in range(max(1, n // FUSE)):
+            cur, acc = window_digest(tables, cur, acc, *stacked)
         _ = int(np.asarray(acc))  # one scalar D2H closes the window
         return time.time() - t0
 
-    run_window(4)  # warm
+    window = max(FUSE, window - window % FUSE)
+    run_window(FUSE)  # warm
     total = run_window(window)
     per_batch = total / window
     matches_per_sec = B * window / total
     log(f"pipelined: {per_batch * 1000:.2f}ms/batch amortized, "
         f"{matches_per_sec / 1e6:.1f}M topic-matches/s "
-        f"({window} batches of {B})")
+        f"({window} batches of {B}, {FUSE} per dispatch)")
 
     # device-only step time via jax.profiler (VERDICT item 5): decomposes
     # the relay-inclusive sync latency into device execution vs dispatch
     # overhead. Best-effort — {} when the backend can't trace.
-    step_profile = profile_device_step(lambda: run_window(12),
-                                       "step_digest")
+    def run_single_steps(n=12):
+        cur = cursors0
+        acc = _put_retry(np.int32(0))
+        for i in range(n):
+            cur, acc = step_digest(tables, cur, acc, *staged[i % 8])
+        _ = int(np.asarray(acc))
+
+    run_single_steps(2)   # compile outside the trace
+    step_profile = profile_device_step(run_single_steps, "step_digest")
     if step_profile:
         log(f"device step: p50 {step_profile['device_step_p50_ms']}ms "
             f"p99 {step_profile['device_step_p99_ms']}ms on "
@@ -382,6 +409,7 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         "sync_p99_ms": round(p99_ms, 1),
         "batch": B,
         "subs": subs,
+        "fuse": FUSE,
         "table_build_s": round(t_build, 1),
     }
 

@@ -185,3 +185,57 @@ class TestRankOccurOracle:
                     == want_rank[flat >= 0]).all()
             want_occur = np.bincount(flat[flat >= 0], minlength=G)
             assert (occur == want_occur).all()
+
+
+class TestRouteWindow:
+    """The W-fused window step (one dispatch per W batches) must be
+    bit-identical to W sequential route_step_shapes calls: same digests,
+    same threaded cursors."""
+
+    def test_window_equals_sequential(self):
+        from emqx_tpu.models.router_engine import (ShapeRouterTables,
+                                                   route_digest,
+                                                   route_step_shapes,
+                                                   route_window_shapes)
+        from emqx_tpu.ops.shapes import build_shape_tables
+
+        filters = ["dev/+/t", "dev/#", "q/job", "+/x/+"]
+        intern = I.InternTable()
+        rows = np.zeros((len(filters), 8), np.int32)
+        lens = np.zeros(len(filters), np.int64)
+        for fid, f in enumerate(filters):
+            w = intern.encode_filter(T.words(f))
+            rows[fid, :len(w)] = w
+            lens[fid] = len(w)
+        st = build_shape_tables(rows, lens)
+        normal = {0: [(1, 1)], 1: [(2, 2)], 3: [(3, 1)]}
+        shared = {0: [(50, 1), (51, 1), (52, 1)]}
+        subs = build_subtable(len(filters), normal, {2: [0]}, shared)
+        tables = ShapeRouterTables(shapes=st, subs=subs)
+
+        rng = np.random.RandomState(11)
+        W, B = 4, 8
+        topics = ["dev/a/t", "q/job", "n/x/m", "dev/b/c", "none"]
+        batches = [[topics[rng.randint(len(topics))] for _ in range(B)]
+                   for _ in range(W)]
+        encs = [encode(intern, bt) for bt in batches]
+        hashes = rng.randint(0, 1 << 30, size=(W, B)).astype(np.int32)
+        strat = np.int32(STRATEGY_ROUND_ROBIN)
+
+        # sequential reference
+        cur = np.zeros(1, np.int32)
+        want = []
+        for k in range(W):
+            enc, lens_, dol = encs[k]
+            r = route_step_shapes(tables, cur, enc, lens_, dol, hashes[k],
+                                  strat, fanout_cap=8, slot_cap=4)
+            want.append(int(route_digest(r)))
+            cur = r.new_cursors
+
+        stacked = tuple(np.stack([encs[k][i] for k in range(W)])
+                        for i in range(3))
+        new_cur, digests = route_window_shapes(
+            tables, np.zeros(1, np.int32), stacked[0], stacked[1],
+            stacked[2], hashes, strat, fanout_cap=8, slot_cap=4)
+        assert list(np.asarray(digests)) == want
+        assert list(np.asarray(new_cur)) == list(np.asarray(cur))
