@@ -104,8 +104,12 @@ def main():
     FAN_CAP = int(os.environ.get("BENCH_FANOUT_CAP", 4))
     SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 2))
 
-    def timed(name, fn, *args_fn):
-        """Pipelined window of `fn(staged[i], ...)` closed by scalar read."""
+    def timed(name, fn, topics_per_call=B):
+        """Pipelined window of `fn(staged[i], ...)` closed by scalar read.
+        topics_per_call: how many topics one call routes (a fused-window
+        call routes FUSE*B — the table stays per-batch honest)."""
+        batches_per_call = topics_per_call // B
+
         def run(n):
             acc = _put_retry(np.int32(0))
             t0 = time.time()
@@ -115,8 +119,9 @@ def main():
             return time.time() - t0
         run(2)  # warm/compile
         dt = run(window)
-        per_ms = dt / window * 1000
-        log(f"{name:34s} {per_ms:8.2f} ms/batch   {B*window/dt/1e6:6.1f}M/s")
+        per_ms = dt / (window * batches_per_call) * 1000
+        log(f"{name:34s} {per_ms:8.2f} ms/batch   "
+            f"{topics_per_call*window/dt/1e6:6.1f}M/s")
         return per_ms
 
     # 1. match only
@@ -183,13 +188,40 @@ def main():
                 + r.match_counts.sum(dtype=jnp.int32)
                 + r.opts.sum(dtype=jnp.int32))
 
+    # 6. W-fused window (one dispatch per FUSE batches) — what bench.py
+    # now measures; the delta vs f_full isolates per-dispatch overhead
+    from emqx_tpu.models.router_engine import (route_digest,
+                                               route_window_shapes)
+    FUSE = max(1, min(int(os.environ.get("BENCH_FUSE", 8)), 8))
+    stacked = tuple(jnp.stack([staged[k % 8][i] for k in range(FUSE)])
+                    for i in range(4))
+
+    @jax.jit
+    def f_window(acc, _batch):
+        new_cur, digests = route_window_shapes(
+            tables, cursors0, stacked[0], stacked[1], stacked[2],
+            stacked[3], strat, fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
+        return acc + digests.sum(dtype=jnp.int32)
+
+    # 7. pallas fold backend (match-only, lane-major kernel)
+    from emqx_tpu.ops.shapes import shape_match_pallas
+
+    @jax.jit
+    def f_match_pallas(acc, batch):
+        t, l, d, h = batch
+        r = shape_match_pallas(tables.shapes, t, l, d)
+        return acc + r.matches.sum(dtype=jnp.int32) + r.counts.sum()
+
     timed("match only", f_match)
+    timed("match only (pallas fold)", f_match_pallas)
     timed("match+fanout", f_fan)
     timed("match+shared_slots", f_slots)
     timed("match+slots+pick_members", f_shared)
-    timed("rank_over_runs (argsort) alone", f_rank)
+    timed("rank/occur alone", f_rank)
     timed("occur scatter-add alone", f_occur)
     timed("FULL route_step + digest", f_full)
+    timed(f"FUSED window x{FUSE} (per batch)", f_window,
+          topics_per_call=B * FUSE)
 
 
 if __name__ == "__main__":
