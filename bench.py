@@ -556,6 +556,32 @@ def main():
         }), flush=True)
         os._exit(2)
 
+    # backend-init probe in a CHILD process: a wedged axon relay/pool
+    # hangs jax.devices() inside C (observed round 3), where a SIGALRM
+    # python handler can never run — so the parent must not touch jax
+    # until a disposable child proves the backend answers
+    import subprocess
+
+    init_budget = int(os.environ.get("BENCH_INIT_TIMEOUT_S", 600))
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=init_budget, text=True)
+        ok = probe.returncode == 0
+        detail = (probe.stdout or probe.stderr or "").strip()[-200:]
+    except subprocess.TimeoutExpired:
+        ok, detail = False, f"device probe hung > {init_budget}s"
+    if not ok:
+        print(json.dumps({
+            "metric": "topic_matches_per_sec",
+            "value": 0,
+            "unit": "topic-matches/s",
+            "vs_baseline": 0.0,
+            "error": f"backend init failed: {detail}",
+        }), flush=True)
+        os._exit(2)
+    log(f"backend probe ok: {detail} device(s)")
+
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", 2400)))
 

@@ -105,7 +105,10 @@ def main():
     SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 2))
 
     def timed(name, fn, topics_per_call=B):
-        """Pipelined window of `fn(staged[i], ...)` closed by scalar read.
+        """Pipelined window of `fn(acc, tables, staged[i])` closed by one
+        scalar read. Tables ride as explicit jit arguments — closing over
+        them would bake the bucket table into the HLO, which the relay
+        rejects at bench scale (same rule as bench.py's step_digest).
         topics_per_call: how many topics one call routes (a fused-window
         call routes FUSE*B — the table stays per-batch honest)."""
         batches_per_call = topics_per_call // B
@@ -114,7 +117,7 @@ def main():
             acc = _put_retry(np.int32(0))
             t0 = time.time()
             for i in range(n):
-                acc = fn(acc, staged[i % 8])
+                acc = fn(acc, tables, staged[i % 8])
             _ = int(np.asarray(acc))
             return time.time() - t0
         run(2)  # warm/compile
@@ -126,41 +129,41 @@ def main():
 
     # 1. match only
     @jax.jit
-    def f_match(acc, batch):
+    def f_match(acc, tb, batch):
         t, l, d, h = batch
-        r = shape_match(tables.shapes, t, l, d)
+        r = shape_match(tb.shapes, t, l, d)
         return acc + r.matches.sum(dtype=jnp.int32) + r.counts.sum()
 
     # 2. match + fanout_normal
     @jax.jit
-    def f_fan(acc, batch):
+    def f_fan(acc, tb, batch):
         t, l, d, h = batch
-        r = shape_match(tables.shapes, t, l, d)
-        fr = fanout_normal(tables.subs, r.matches, fanout_cap=FAN_CAP)
+        r = shape_match(tb.shapes, t, l, d)
+        fr = fanout_normal(tb.subs, r.matches, fanout_cap=FAN_CAP)
         return (acc + fr.rows.sum(dtype=jnp.int32) + fr.counts.sum()
                 + fr.opts.sum(dtype=jnp.int32))
 
     # 3. match + shared_slots
     @jax.jit
-    def f_slots(acc, batch):
+    def f_slots(acc, tb, batch):
         t, l, d, h = batch
-        r = shape_match(tables.shapes, t, l, d)
-        sids, ov = shared_slots(tables.subs, r.matches, slot_cap=SLOT_CAP)
+        r = shape_match(tb.shapes, t, l, d)
+        sids, ov = shared_slots(tb.subs, r.matches, slot_cap=SLOT_CAP)
         return acc + sids.sum(dtype=jnp.int32) + ov.sum()
 
     # 4. match + slots + pick_members (full shared path)
     @jax.jit
-    def f_shared(acc, batch):
+    def f_shared(acc, tb, batch):
         t, l, d, h = batch
-        r = shape_match(tables.shapes, t, l, d)
-        sids, ov = shared_slots(tables.subs, r.matches, slot_cap=SLOT_CAP)
-        sp = pick_members(tables.subs, cursors0, sids, strat, h)
+        r = shape_match(tb.shapes, t, l, d)
+        sids, ov = shared_slots(tb.subs, r.matches, slot_cap=SLOT_CAP)
+        sp = pick_members(tb.subs, cursors0, sids, strat, h)
         return (acc + sp.rows.sum(dtype=jnp.int32)
                 + sp.new_cursors.sum(dtype=jnp.int32))
 
-    # 4b. rank+occur alone (the argsort + unique scatters)
+    # 4b. rank+occur alone (the sort-free blocked kernel on accelerators)
     @jax.jit
-    def f_rank(acc, batch):
+    def f_rank(acc, tb, batch):
         t, l, d, h = batch
         sids = jnp.stack([h % np.int32(n_groups),
                           jnp.full((B,), -1, jnp.int32)], axis=1)
@@ -170,17 +173,17 @@ def main():
 
     # 4c. occur scatter-add alone
     @jax.jit
-    def f_occur(acc, batch):
+    def f_occur(acc, tb, batch):
         t, l, d, h = batch
         safe = (h % np.int32(n_groups)).astype(jnp.int32)
         occur = jnp.zeros(n_groups, jnp.int32).at[safe].add(1, mode="drop")
         return acc + occur.sum(dtype=jnp.int32)
 
-    # 5. full fused step + digest (= the bench step)
+    # 5. full fused step + digest (= the bench single-batch step)
     @jax.jit
-    def f_full(acc, batch):
+    def f_full(acc, tb, batch):
         t, l, d, h = batch
-        r = route_step_shapes(tables, cursors0, t, l, d, h, strat,
+        r = route_step_shapes(tb, cursors0, t, l, d, h, strat,
                               fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
         return (acc + r.rows.sum(dtype=jnp.int32)
                 + r.fan_counts.sum(dtype=jnp.int32)
@@ -190,26 +193,28 @@ def main():
 
     # 6. W-fused window (one dispatch per FUSE batches) — what bench.py
     # now measures; the delta vs f_full isolates per-dispatch overhead
-    from emqx_tpu.models.router_engine import (route_digest,
-                                               route_window_shapes)
+    from emqx_tpu.models.router_engine import route_window_shapes
     FUSE = max(1, min(int(os.environ.get("BENCH_FUSE", 8)), 8))
     stacked = tuple(jnp.stack([staged[k % 8][i] for k in range(FUSE)])
                     for i in range(4))
 
     @jax.jit
-    def f_window(acc, _batch):
+    def f_window_impl(acc, tb, t4, l4, d4, h4):
         new_cur, digests = route_window_shapes(
-            tables, cursors0, stacked[0], stacked[1], stacked[2],
-            stacked[3], strat, fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
+            tb, cursors0, t4, l4, d4, h4, strat,
+            fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
         return acc + digests.sum(dtype=jnp.int32)
+
+    def f_window(acc, tb, _batch):
+        return f_window_impl(acc, tb, *stacked)
 
     # 7. pallas fold backend (match-only, lane-major kernel)
     from emqx_tpu.ops.shapes import shape_match_pallas
 
     @jax.jit
-    def f_match_pallas(acc, batch):
+    def f_match_pallas(acc, tb, batch):
         t, l, d, h = batch
-        r = shape_match_pallas(tables.shapes, t, l, d)
+        r = shape_match_pallas(tb.shapes, t, l, d)
         return acc + r.matches.sum(dtype=jnp.int32) + r.counts.sum()
 
     timed("match only", f_match)
