@@ -431,6 +431,16 @@ def _probe_buckets(st: ShapeTables, h1, h2, b1, b2,
                        overflow=jnp.zeros(B, bool))
 
 
+# fold backend for the serving path: "xla" (default) or "pallas" (the
+# lane-major fused kernel, ops/pallas_fold.py). Bit-identical results
+# either way (oracle-tested), so this is purely a measured-performance
+# switch — flip via env EMQX_TPU_FOLD=pallas after the bench's
+# match_pallas_per_s beats match_xla_per_s on the target hardware.
+import os as _os
+
+_FOLD_BACKEND = _os.environ.get("EMQX_TPU_FOLD", "xla")
+
+
 @jax.jit
 def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
                 is_dollar: jax.Array) -> MatchResult:
@@ -441,7 +451,14 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
     output is exhaustive by construction: every filter lives in one of its
     two home buckets).
     """
-    h1, h2, b1, b2, compatible = _fold_xla(st, topics, lens, is_dollar)
+    if _FOLD_BACKEND == "pallas":
+        from emqx_tpu.ops.pallas_fold import shape_fold_pallas
+        h1, h2, b1, b2, compatible = shape_fold_pallas(
+            topics, lens.astype(jnp.int32), is_dollar,
+            st.shape_plus_mask, st.shape_len, st.shape_has_hash,
+            st.shape_wild_root, L=topics.shape[1], NB=st.buckets.shape[0])
+    else:
+        h1, h2, b1, b2, compatible = _fold_xla(st, topics, lens, is_dollar)
     return _probe_buckets(st, h1, h2, b1, b2, compatible)
 
 
