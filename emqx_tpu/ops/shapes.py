@@ -439,6 +439,19 @@ def _probe_buckets(st: ShapeTables, h1, h2, b1, b2,
 import os as _os
 
 _FOLD_BACKEND = _os.environ.get("EMQX_TPU_FOLD", "xla")
+if _FOLD_BACKEND not in ("xla", "pallas"):
+    raise ValueError(
+        f"EMQX_TPU_FOLD={_FOLD_BACKEND!r}: expected 'xla' or 'pallas'")
+
+
+def _fold_pallas(st: ShapeTables, topics, lens, is_dollar):
+    """The pallas fold with shape_match's calling convention (shared by
+    the env-selected serving path and the benchmarked pallas entry)."""
+    from emqx_tpu.ops.pallas_fold import shape_fold_pallas
+    return shape_fold_pallas(
+        topics, lens.astype(jnp.int32), is_dollar,
+        st.shape_plus_mask, st.shape_len, st.shape_has_hash,
+        st.shape_wild_root, L=topics.shape[1], NB=st.buckets.shape[0])
 
 
 @jax.jit
@@ -452,11 +465,8 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
     two home buckets).
     """
     if _FOLD_BACKEND == "pallas":
-        from emqx_tpu.ops.pallas_fold import shape_fold_pallas
-        h1, h2, b1, b2, compatible = shape_fold_pallas(
-            topics, lens.astype(jnp.int32), is_dollar,
-            st.shape_plus_mask, st.shape_len, st.shape_has_hash,
-            st.shape_wild_root, L=topics.shape[1], NB=st.buckets.shape[0])
+        h1, h2, b1, b2, compatible = _fold_pallas(st, topics, lens,
+                                                  is_dollar)
     else:
         h1, h2, b1, b2, compatible = _fold_xla(st, topics, lens, is_dollar)
     return _probe_buckets(st, h1, h2, b1, b2, compatible)
@@ -468,9 +478,5 @@ def shape_match_pallas(st: ShapeTables, topics: jax.Array,
                        is_dollar: jax.Array) -> MatchResult:
     """shape_match with the fold stage as a fused Pallas kernel
     (ops/pallas_fold.py); bit-identical results by construction."""
-    from emqx_tpu.ops.pallas_fold import shape_fold_pallas
-    h1, h2, b1, b2, compat = shape_fold_pallas(
-        topics, lens.astype(jnp.int32), is_dollar,
-        st.shape_plus_mask, st.shape_len, st.shape_has_hash,
-        st.shape_wild_root, L=topics.shape[1], NB=st.buckets.shape[0])
+    h1, h2, b1, b2, compat = _fold_pallas(st, topics, lens, is_dollar)
     return _probe_buckets(st, h1, h2, b1, b2, compat)
