@@ -160,12 +160,52 @@ def profile_device_step(run_fn, match_name: str) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def device_filter_set(subs: int):
+    """The reference harness's device/{{id}}/+/{{num}}/# filter set scaled
+    to `subs` (emqx_broker_bench.erl:25-34) — the ONE canonical workload
+    generator, shared by the main bench and the config-3 suite row so the
+    two can never silently measure different workloads."""
+    from emqx_tpu.ops import intern as I
+    ids = max(64, int(np.sqrt(subs)))
+    nums = max(1, subs // ids)
+    F = ids * nums
+    intern = I.InternTable()
+    wd = intern.intern("device")
+    id_ids = np.array([intern.intern(f"d{i}") for i in range(ids)], np.int32)
+    num_ids = np.array([intern.intern(f"n{n}") for n in range(nums)],
+                       np.int32)
+    rows = np.zeros((F, 8), np.int32)
+    lens = np.full(F, 5, np.int64)
+    rows[:, 0] = wd
+    rows[:, 1] = np.repeat(id_ids, nums)
+    rows[:, 2] = I.PLUS
+    rows[:, 3] = np.tile(num_ids, ids)
+    rows[:, 4] = I.HASH
+    return {"intern": intern, "rows": rows, "lens": lens, "ids": ids,
+            "nums": nums, "id_ids": id_ids, "num_ids": num_ids, "wd": wd}
+
+
+def device_topic_batch(fs: dict, rng, B: int):
+    """One Zipf-skewed publish batch; every topic matches exactly one
+    filter of device_filter_set (fid = id*nums + num)."""
+    intern = fs["intern"]
+    x = intern.intern("x")
+    tail = intern.intern("t")
+    zipf = np.minimum(rng.zipf(1.3, size=B) - 1, fs["ids"] - 1)
+    tp = np.zeros((B, 8), np.int32)
+    tp[:, 0] = fs["wd"]
+    tp[:, 1] = fs["id_ids"][zipf]
+    tp[:, 2] = x
+    tp[:, 3] = fs["num_ids"][rng.randint(0, fs["nums"], B)]
+    tp[:, 4] = tail
+    return tp, np.full(B, 5, np.int32)
+
+
 def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     import jax
 
     from emqx_tpu.models.router_engine import (ShapeRouterTables,
                                                route_step_shapes)
-    from emqx_tpu.ops import intern as I
     from emqx_tpu.ops.fanout import SubTable
     from emqx_tpu.ops.shapes import build_shape_tables
     from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
@@ -174,20 +214,10 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         f"device={jax.devices()[0]}")
 
     # --- filter set: device/{id}/+/{num}/#  ------------------------------
-    ids = max(64, int(np.sqrt(subs)))
-    nums = max(1, subs // ids)
+    fs = device_filter_set(subs)
+    intern, rows, lens = fs["intern"], fs["rows"], fs["lens"]
+    ids, nums = fs["ids"], fs["nums"]
     F = ids * nums
-    intern = I.InternTable()
-    wd = intern.intern("device")
-    id_ids = np.array([intern.intern(f"d{i}") for i in range(ids)], np.int32)
-    num_ids = np.array([intern.intern(f"n{n}") for n in range(nums)], np.int32)
-    rows = np.zeros((F, 8), np.int32)
-    lens = np.full(F, 5, np.int64)
-    rows[:, 0] = wd
-    rows[:, 1] = np.repeat(id_ids, nums)
-    rows[:, 2] = I.PLUS
-    rows[:, 3] = np.tile(num_ids, ids)
-    rows[:, 4] = I.HASH
 
     t0 = time.time()
     shapes = build_shape_tables(rows, lens)
@@ -221,20 +251,12 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
 
     # --- pre-staged publish batches (Zipf-skewed device ids) -------------
-    x = intern.intern("x")
-    tail = intern.intern("t")
     rng = np.random.RandomState(7)
     staged = []
     for k in range(8):
-        zipf = np.minimum(rng.zipf(1.3, size=B) - 1, ids - 1)
-        tp = np.zeros((B, 8), np.int32)
-        tp[:, 0] = wd
-        tp[:, 1] = id_ids[zipf]
-        tp[:, 2] = x
-        tp[:, 3] = num_ids[rng.randint(0, nums, B)]
-        tp[:, 4] = tail
+        tp, tl = device_topic_batch(fs, rng, B)
         staged.append((_put_retry(tp),
-                       _put_retry(np.full(B, 5, np.int32)),
+                       _put_retry(tl),
                        _put_retry(np.zeros(B, bool)),
                        _put_retry(rng.randint(0, 1 << 30, B)
                                   .astype(np.int32))))
@@ -412,6 +434,141 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         "fuse": FUSE,
         "table_build_s": round(t_build, 1),
     }
+
+
+def run_baseline_configs(B: int, window: int) -> dict:
+    """BASELINE.md configs 1-3 at their stated scales, each as a fused
+    window over its own compiled tables (config 4 IS the main bench;
+    config 5 needs a 2-node cluster and is covered functionally by
+    tests/test_cluster.py + the retainer tests, not this chip bench).
+
+    1: 1k exact-match subs, single-level topics
+    2: 100k subs with '+' wildcards, 6-level hierarchy
+    3: 1M subs mixed '+'/'#', Zipf-skewed publish
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.models.router_engine import (ShapeRouterTables,
+                                               route_window_shapes)
+    from emqx_tpu.ops import intern as I
+    from emqx_tpu.ops.fanout import SubTable
+    from emqx_tpu.ops.shapes import build_shape_tables
+    from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+
+    rng = np.random.RandomState(13)
+    out = {}
+
+    def one(name, rows, lens, topic_of):
+        F = len(lens)
+        shapes = build_shape_tables(rows, lens)
+        subs_tbl = SubTable(
+            sub_start=np.arange(F + 1, dtype=np.int32),
+            sub_row=np.arange(F, dtype=np.int32),
+            sub_opts=np.ones(F, np.int8),
+            fs_start=np.zeros(F + 1, np.int32),
+            fs_slot=np.full(1, -1, np.int32),
+            shared_start=np.zeros(2, np.int32),
+            shared_row=np.full(1, -1, np.int32),
+            shared_opts=np.zeros(1, np.int8))
+        tables = put_tree_chunked(
+            ShapeRouterTables(shapes=shapes, subs=subs_tbl))
+        jax.block_until_ready(tables)
+        L = rows.shape[1]
+        W = 4
+        tp = np.zeros((W, B, L), np.int32)
+        tl = np.zeros((W, B), np.int32)
+        for w in range(W):
+            enc, ls = topic_of(rng, B)
+            tp[w, :, :enc.shape[1]] = enc
+            tl[w] = ls
+        t4 = _put_retry(tp)
+        l4 = _put_retry(tl)
+        d4 = _put_retry(np.zeros((W, B), bool))
+        h4 = _put_retry(rng.randint(0, 1 << 30, (W, B)).astype(np.int32))
+        cur = _put_retry(np.zeros(1, np.int32))
+        strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
+
+        @jax.jit
+        def wd(tb, c, acc, t, l_, d, h):
+            nc, digests = route_window_shapes(
+                tb, c, t, l_, d, h, strat, fanout_cap=4, slot_cap=2)
+            return acc + digests.sum(dtype=jnp.int32)
+
+        def run(n):
+            acc = _put_retry(np.int32(0))
+            t0 = time.time()
+            for _ in range(n):
+                acc = wd(tables, cur, acc, t4, l4, d4, h4)
+            _ = int(np.asarray(acc))
+            return time.time() - t0
+
+        # sanity: every generated topic must match exactly one filter
+        from emqx_tpu.ops.shapes import shape_match
+        mc = int(np.asarray(shape_match(
+            tables.shapes, t4[0], l4[0], d4[0]).counts).sum())
+        assert mc == B, f"config {name}: {mc}/{B} topics matched"
+
+        run(1)   # compile
+        n_calls = max(1, window // W)
+        dt = run(n_calls)
+        per_s = B * W * n_calls / dt
+        out[name] = {"subs": F, "matches_per_s": round(per_s)}
+        log(f"config {name}: {per_s / 1e6:.1f}M matches/s at {F} subs")
+
+    # config 1: 1k exact-match, single-level
+    intern = I.InternTable()
+    F1 = 1000
+    w1 = np.array([intern.intern(f"t{i}") for i in range(F1)], np.int32)
+    rows = w1[:, None]
+    lens = np.ones(F1, np.int64)
+
+    def topics1(rng, B):
+        pick = rng.randint(0, F1, B)
+        return w1[pick][:, None], np.ones(B, np.int32)
+
+    one("1_exact_1k", rows, lens, topics1)
+
+    # config 2: 100k '+'-wildcard subs, 6-level hierarchy
+    # filter: a/{i}/+/b/{j}/+  — two '+' per filter, 6 levels
+    intern = I.InternTable()
+    n_i, n_j = 400, 250
+    F2 = n_i * n_j
+    wa = intern.intern("a")
+    wb = intern.intern("b")
+    wi = np.array([intern.intern(f"i{i}") for i in range(n_i)], np.int32)
+    wj = np.array([intern.intern(f"j{j}") for j in range(n_j)], np.int32)
+    rows = np.zeros((F2, 6), np.int32)
+    rows[:, 0] = wa
+    rows[:, 1] = np.repeat(wi, n_j)
+    rows[:, 2] = I.PLUS
+    rows[:, 3] = wb
+    rows[:, 4] = np.tile(wj, n_i)
+    rows[:, 5] = I.PLUS
+    lens = np.full(F2, 6, np.int64)
+    wx = intern.intern("x")
+
+    def topics2(rng, B):
+        enc = np.zeros((B, 6), np.int32)
+        enc[:, 0] = wa
+        enc[:, 1] = wi[rng.randint(0, n_i, B)]
+        enc[:, 2] = wx
+        enc[:, 3] = wb
+        enc[:, 4] = wj[rng.randint(0, n_j, B)]
+        enc[:, 5] = wx
+        return enc, np.full(B, 6, np.int32)
+
+    one("2_plus_100k", rows, lens, topics2)
+
+    # config 3: 1M mixed '+'/'#', Zipf-skewed publish — the canonical
+    # device_filter_set workload at 1M (same generator as the main bench)
+    fs3 = device_filter_set(1_000_000)
+
+    def topics3(rng, B):
+        return device_topic_batch(fs3, rng, B)
+
+    one("3_mixed_1M_zipf", fs3["rows"], fs3["lens"], topics3)
+    return out
 
 
 def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
@@ -600,8 +757,24 @@ def main():
                 result["requested_subs"] = requested
                 result["stepdown_errors"] = errors
             # core result is in hand: the global watchdog must not be able
-            # to discard it over the best-effort e2e phase
+            # to discard it over the best-effort config-suite/e2e phases
             signal.alarm(0)
+            if os.environ.get("BENCH_CONFIGS", "1") != "0":
+                def _cfg_alarm(signum, frame):
+                    raise TimeoutError("config suite watchdog")
+
+                signal.signal(signal.SIGALRM, _cfg_alarm)
+                try:
+                    signal.alarm(int(os.environ.get(
+                        "BENCH_CONFIGS_TIMEOUT_S", 600)))
+                    result["configs"] = run_baseline_configs(
+                        min(B, 32768), max(8, window // 4))
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"config suite failed: {type(e).__name__}: {e}")
+                    result["configs_error"] = \
+                        f"{type(e).__name__}: {str(e)[:160]}"
+                finally:
+                    signal.alarm(0)
             if os.environ.get("BENCH_E2E", "1") != "0":
                 ef = int(os.environ.get("BENCH_E2E_FILTERS", 100_000))
                 em = int(os.environ.get("BENCH_E2E_MSGS", 32_000))
