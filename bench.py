@@ -201,6 +201,39 @@ def device_topic_batch(fs: dict, rng, B: int):
     return tp, np.full(B, 5, np.int32)
 
 
+def make_window_runner(tables, cursors0, strat, stacked,
+                       fan_cap: int, slot_cap: int):
+    """The ONE fused-window timing kernel, shared by the main bench and
+    the config suite (so the two can never measure different work).
+    Returns run(n_calls) -> seconds: dispatches the W-fused window
+    n_calls times with cursors threaded call-to-call, closed by a single
+    scalar readback. Tables/batches ride as jit arguments — closing over
+    them would bake the bucket table into the HLO (relay-rejected at
+    scale)."""
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.models.router_engine import route_window_shapes
+
+    @jax.jit
+    def wd(tb, cur, acc, topics, lens_, dollar, hashes):
+        new_cur, digests = route_window_shapes(
+            tb, cur, topics, lens_, dollar, hashes, strat,
+            fanout_cap=fan_cap, slot_cap=slot_cap)
+        return new_cur, acc + digests.sum(dtype=jnp.int32)
+
+    def run(n_calls: int) -> float:
+        cur = cursors0
+        acc = _put_retry(np.int32(0))
+        t0 = time.time()
+        for _ in range(n_calls):
+            cur, acc = wd(tables, cur, acc, *stacked)
+        _ = int(np.asarray(acc))  # one scalar D2H closes the window
+        return time.time() - t0
+
+    return run
+
+
 def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     import jax
 
@@ -325,8 +358,6 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     # per-call dispatch floor — visible in round 2 as the gap between the
     # match fold's arithmetic rate and the match-only call rate — is paid
     # once per W batches. Oracle-tested bit-identical to sequential steps.
-    from emqx_tpu.models.router_engine import route_window_shapes
-
     FUSE = max(1, min(int(os.environ.get("BENCH_FUSE", 8)), len(staged),
                       window))
     if window % FUSE:
@@ -335,21 +366,11 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     stacked = tuple(jnp.stack([staged[k][i] for k in range(FUSE)])
                     for i in range(4))
 
-    @jax.jit
-    def window_digest(tb, cur, acc, topics, lens_, dollar, hashes):
-        new_cur, digests = route_window_shapes(
-            tb, cur, topics, lens_, dollar, hashes, strat,
-            fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
-        return new_cur, acc + digests.sum(dtype=jnp.int32)
+    runner = make_window_runner(tables, cursors0, strat, stacked,
+                                FAN_CAP, SLOT_CAP)
 
     def run_window(n):
-        cur = cursors0
-        acc = _put_retry(np.int32(0))
-        t0 = time.time()
-        for _ in range(max(1, n // FUSE)):
-            cur, acc = window_digest(tables, cur, acc, *stacked)
-        _ = int(np.asarray(acc))  # one scalar D2H closes the window
-        return time.time() - t0
+        return runner(max(1, n // FUSE))
 
     window = max(FUSE, window - window % FUSE)
     run_window(FUSE)  # warm
@@ -449,8 +470,7 @@ def run_baseline_configs(B: int, window: int) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from emqx_tpu.models.router_engine import (ShapeRouterTables,
-                                               route_window_shapes)
+    from emqx_tpu.models.router_engine import ShapeRouterTables
     from emqx_tpu.ops import intern as I
     from emqx_tpu.ops.fanout import SubTable
     from emqx_tpu.ops.shapes import build_shape_tables
@@ -488,20 +508,8 @@ def run_baseline_configs(B: int, window: int) -> dict:
         h4 = _put_retry(rng.randint(0, 1 << 30, (W, B)).astype(np.int32))
         cur = _put_retry(np.zeros(1, np.int32))
         strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
-
-        @jax.jit
-        def wd(tb, c, acc, t, l_, d, h):
-            nc, digests = route_window_shapes(
-                tb, c, t, l_, d, h, strat, fanout_cap=4, slot_cap=2)
-            return acc + digests.sum(dtype=jnp.int32)
-
-        def run(n):
-            acc = _put_retry(np.int32(0))
-            t0 = time.time()
-            for _ in range(n):
-                acc = wd(tables, cur, acc, t4, l4, d4, h4)
-            _ = int(np.asarray(acc))
-            return time.time() - t0
+        run = make_window_runner(tables, cur, strat, (t4, l4, d4, h4),
+                                 fan_cap=4, slot_cap=2)
 
         # sanity: every generated topic must match exactly one filter
         from emqx_tpu.ops.shapes import shape_match
@@ -770,6 +778,8 @@ def main():
                     result["configs"] = run_baseline_configs(
                         min(B, 32768), max(8, window // 4))
                 except Exception as e:  # noqa: BLE001 — best-effort
+                    signal.alarm(0)   # before anything else: the pending
+                    # alarm must not fire inside this handler and escape
                     log(f"config suite failed: {type(e).__name__}: {e}")
                     result["configs_error"] = \
                         f"{type(e).__name__}: {str(e)[:160]}"
@@ -789,6 +799,7 @@ def main():
                     result["e2e_device"] = run_e2e(ef, 16, 8, em // 8, True)
                     result["e2e_host"] = run_e2e(ef, 16, 8, em // 8, False)
                 except Exception as e:  # noqa: BLE001 — e2e is best-effort
+                    signal.alarm(0)   # see config-suite handler
                     log(f"e2e bench failed: {type(e).__name__}: {e}")
                     traceback.print_exc(file=sys.stderr)
                     result["e2e_error"] = f"{type(e).__name__}: {str(e)[:200]}"

@@ -359,7 +359,11 @@ def _render(val: Any, indent: int) -> str:
     if isinstance(val, (int, float)):
         return str(val)
     s = str(val)
-    if _BARE_RE.match(s):
+    # only render bare if the parser would read the SAME string back:
+    # "0", "true", "off" etc. coerce to typed values on load, which would
+    # silently change a string's type across an override persist/reload
+    # cycle (found by the dumps→loads property test)
+    if _BARE_RE.match(s) and isinstance(_coerce_scalar(s, HoconError), str):
         return s
     return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
 

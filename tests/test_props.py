@@ -1,0 +1,257 @@
+"""Property-based suites (hypothesis) mirroring the reference's PropEr
+props (apps/emqx/test/props/: prop_emqx_frame, prop_emqx_reason_codes,
+prop_emqx_psk — SURVEY §4 "Property-based" row). Hypothesis plays PropEr's
+role: generative packets with shrinking, plus a parser-totality fuzz the
+randomized tests can't express.
+"""
+
+import binascii
+
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt.frame import FrameError, FrameParser, serialize
+from emqx_tpu.mqtt.packet import (Auth, Connect, Disconnect, Puback, Publish,
+                                  SubOpts, Subscribe, Unsubscribe, Will)
+
+SETTLE = settings(max_examples=120, deadline=None,
+                  suppress_health_check=[HealthCheck.too_slow])
+
+# MQTT UTF-8 strings: no NUL, bounded size
+mqtt_text = st.text(
+    alphabet=st.characters(blacklist_characters="\x00",
+                           blacklist_categories=("Cs",)),
+    min_size=1, max_size=24)
+payloads = st.binary(max_size=256)
+packet_ids = st.integers(min_value=1, max_value=0xFFFF)
+
+
+@st.composite
+def publishes(draw, version):
+    qos = draw(st.integers(0, 2))
+    props = {}
+    if version == C.MQTT_V5:
+        props = draw(st.fixed_dictionaries(
+            {}, optional={
+                "message_expiry_interval": st.integers(0, 2**32 - 1),
+                "content_type": mqtt_text,
+                "payload_format_indicator": st.integers(0, 1),
+                "user_property": st.lists(
+                    st.tuples(mqtt_text, mqtt_text), max_size=3),
+            }))
+        if props.get("user_property") == []:
+            del props["user_property"]
+    return Publish(
+        topic=draw(mqtt_text), payload=draw(payloads), qos=qos,
+        packet_id=draw(packet_ids) if qos else None,
+        retain=draw(st.booleans()),
+        dup=draw(st.booleans()) and qos > 0,
+        properties=props)
+
+
+@st.composite
+def connects(draw):
+    ver = draw(st.sampled_from([C.MQTT_V3, C.MQTT_V4, C.MQTT_V5]))
+    will = None
+    if draw(st.booleans()):
+        will = Will(topic=draw(mqtt_text), payload=draw(payloads),
+                    qos=draw(st.integers(0, 2)), retain=draw(st.booleans()))
+    return Connect(
+        proto_ver=ver,
+        proto_name="MQIsdp" if ver == C.MQTT_V3 else "MQTT",
+        clientid=draw(mqtt_text),
+        keepalive=draw(st.integers(0, 0xFFFF)),
+        clean_start=draw(st.booleans()),
+        username=draw(st.none() | mqtt_text),
+        password=draw(st.none() | payloads.filter(bool)),
+        will=will)
+
+
+@st.composite
+def subscribes(draw):
+    n = draw(st.integers(1, 5))
+    return Subscribe(
+        packet_id=draw(packet_ids),
+        filters=[
+            (draw(mqtt_text),
+             SubOpts(qos=draw(st.integers(0, 2)),
+                     nl=draw(st.integers(0, 1)),
+                     rap=draw(st.integers(0, 1)),
+                     rh=draw(st.integers(0, 2))))
+            for _ in range(n)])
+
+
+def _roundtrip(pkt, version):
+    wire = serialize(pkt, version)
+    p = FrameParser(version=None if pkt.type == C.CONNECT else version)
+    out = p.feed(wire)
+    assert len(out) == 1 and p.pending_bytes == 0
+    return out[0]
+
+
+class TestFrameProps:
+    """prop_emqx_frame: serialize → parse == identity, any chunking."""
+
+    @SETTLE
+    @given(pkt=publishes(C.MQTT_V4))
+    def test_publish_v4(self, pkt):
+        assert _roundtrip(pkt, C.MQTT_V4) == pkt
+
+    @SETTLE
+    @given(pkt=publishes(C.MQTT_V5))
+    def test_publish_v5(self, pkt):
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(pkt=connects())
+    def test_connect(self, pkt):
+        assert _roundtrip(pkt, pkt.proto_ver) == pkt
+
+    @SETTLE
+    @given(pkt=subscribes())
+    def test_subscribe_v5(self, pkt):
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(packet_id=packet_ids, rc=st.sampled_from([0, 16, 128, 131]))
+    def test_puback_v5(self, packet_id, rc):
+        pkt = Puback(packet_id=packet_id, reason_code=rc)
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(filters=st.lists(mqtt_text, min_size=1, max_size=4),
+           packet_id=packet_ids)
+    def test_unsubscribe(self, filters, packet_id):
+        pkt = Unsubscribe(packet_id=packet_id, filters=filters)
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(rc=st.sampled_from([0, 4, 129, 142, 152]))
+    def test_disconnect_v5(self, rc):
+        pkt = Disconnect(reason_code=rc)
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(rc=st.sampled_from([0, 24, 25]), data=payloads)
+    def test_auth(self, rc, data):
+        props = {"authentication_method": "SCRAM-SHA-256",
+                 "authentication_data": data} if data else {}
+        pkt = Auth(reason_code=rc, properties=props)
+        assert _roundtrip(pkt, C.MQTT_V5) == pkt
+
+    @SETTLE
+    @given(pkts=st.lists(publishes(C.MQTT_V4), min_size=1, max_size=8),
+           data=st.data())
+    def test_stream_chunking(self, pkts, data):
+        """Any fragmentation of a valid stream parses to the same packets."""
+        wire = b"".join(serialize(p, C.MQTT_V4) for p in pkts)
+        parser = FrameParser(version=C.MQTT_V4)
+        got, i = [], 0
+        while i < len(wire):
+            n = data.draw(st.integers(1, max(1, len(wire) - i)))
+            got += parser.feed(wire[i:i + n])
+            i += n
+        assert got == pkts and parser.pending_bytes == 0
+
+
+class TestParserTotality:
+    """The parser is TOTAL over arbitrary bytes: any input yields packets
+    or FrameError — never another exception, never an infinite loop.
+    (The reference gets this from PropEr generators + fuzzing; it is the
+    internet-facing surface.)"""
+
+    @SETTLE
+    @given(junk=st.binary(min_size=1, max_size=512),
+           version=st.sampled_from([C.MQTT_V4, C.MQTT_V5, None]))
+    def test_arbitrary_bytes(self, junk, version):
+        p = FrameParser(version=version)
+        try:
+            p.feed(junk)
+        except FrameError:
+            pass
+
+    @SETTLE
+    @given(pkt=publishes(C.MQTT_V4),
+           flips=st.lists(st.tuples(st.integers(0, 10**6),
+                                    st.integers(1, 255)), max_size=3))
+    def test_bitflipped_frames(self, pkt, flips):
+        wire = bytearray(serialize(pkt, C.MQTT_V4))
+        for pos, x in flips:
+            wire[pos % len(wire)] ^= x
+        p = FrameParser(version=C.MQTT_V4)
+        try:
+            p.feed(bytes(wire))
+        except FrameError:
+            pass
+
+
+class TestReasonCodeProps:
+    """prop_emqx_reason_codes: compat mapping is total over v5 codes and
+    idempotent (a v3 code maps to itself)."""
+
+    @SETTLE
+    @given(rc=st.integers(0, 0xFF))
+    def test_total_and_v3_valued(self, rc):
+        v3 = C.rc_to_connack_v3(rc)
+        assert 0 <= v3 <= 5
+
+    @SETTLE
+    @given(rc=st.integers(0, 0xFF))
+    def test_idempotent(self, rc):
+        once = C.rc_to_connack_v3(rc)
+        assert C.rc_to_connack_v3(once) == once
+
+
+class TestPskProps:
+    """prop_emqx_psk: the identity:hexkey file format round-trips through
+    the store for any identities/keys."""
+
+    ident = st.text(
+        alphabet=st.characters(blacklist_characters="\x00:\r\n#",
+                               blacklist_categories=("Cs", "Zs")),
+        min_size=1, max_size=16).map(str.strip).filter(bool)
+
+    @SETTLE
+    @given(entries=st.dictionaries(
+        ident, st.binary(min_size=1, max_size=32), min_size=1, max_size=8))
+    def test_file_roundtrip(self, entries):
+        import tempfile
+
+        from emqx_tpu.utils.psk import PskStore
+        lines = ["# psk file"]
+        for ident, key in entries.items():
+            lines.append(f"{ident}:{binascii.hexlify(key).decode()}")
+        with tempfile.NamedTemporaryFile("w", suffix=".psk",
+                                         delete=False) as f:
+            f.write("\n".join(lines) + "\n")
+            path = f.name
+        store = PskStore()
+        assert store.load_file(path) == len(entries)
+        for ident, key in entries.items():
+            assert store.lookup(ident) == key
+
+
+_hocon_keys = st.text(alphabet="abcdefghijklmnop_", min_size=1, max_size=8)
+_hocon_leaf = (st.integers(-2**31, 2**31) | st.booleans()
+               | st.text(alphabet=st.characters(
+                   blacklist_characters="\x00\\\"\r\n$",
+                   blacklist_categories=("Cs",)), max_size=16))
+_hocon_trees = st.recursive(
+    _hocon_leaf,
+    lambda children: (st.dictionaries(_hocon_keys, children, max_size=4)
+                      | st.lists(children, max_size=4)),
+    max_leaves=12)
+
+
+class TestHoconProps:
+    """HOCON-lite dumps → loads == identity over config-shaped trees
+    (the loader is layer-0 boot infrastructure; prop_emqx_json analog)."""
+
+    @SETTLE
+    @given(conf=st.dictionaries(_hocon_keys, _hocon_trees, max_size=4))
+    def test_dumps_loads_identity(self, conf):
+        from emqx_tpu.utils import hocon
+        text = hocon.dumps(conf)
+        assert hocon.loads(text) == conf
