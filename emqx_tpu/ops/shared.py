@@ -46,7 +46,13 @@ class SharedPickResult(NamedTuple):
                             # and rebase cursors consistently)
 
 
-_RANK_BLOCK = 512
+# block width of the sort-free rank scan: larger blocks mean fewer
+# sequential scan steps but a quadratically larger [L, L] in-block
+# compare — sweepable on hardware via env (profile_step shows the
+# rank/occur stage cost directly)
+import os as _os
+
+_RANK_BLOCK = int(_os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
 
 
 def _rank_and_occur_blocked(sids: jax.Array, n_slots: int):
