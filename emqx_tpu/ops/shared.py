@@ -52,7 +52,15 @@ class SharedPickResult(NamedTuple):
 # rank/occur stage cost directly)
 import os as _os
 
-_RANK_BLOCK = int(_os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
+try:
+    _RANK_BLOCK = int(_os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
+except ValueError as _e:
+    raise ValueError(
+        f"EMQX_TPU_RANK_BLOCK must be an integer, got "
+        f"{_os.environ['EMQX_TPU_RANK_BLOCK']!r}") from _e
+if _RANK_BLOCK < 8:
+    raise ValueError(
+        f"EMQX_TPU_RANK_BLOCK must be >= 8, got {_RANK_BLOCK}")
 
 
 def _rank_and_occur_blocked(sids: jax.Array, n_slots: int):

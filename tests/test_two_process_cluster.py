@@ -1,0 +1,144 @@
+"""Two-OS-process cluster: the deployment shape the reference tests with
+scripts/start-two-nodes-in-docker.sh (SURVEY §4 "Multi-node" row).
+
+Each node is a separate python process (tools/run_node.py) with its own
+event loop, RPC listener, and MQTT listener; the harness wires a cluster
+join, then drives real MQTT clients cross-node: subscribe on A, publish
+on B → delivery must cross the node boundary over the RPC channel.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _readline_deadline(p, timeout_s):
+    """readline with a deadline: a node that boots but never prints READY
+    must fail the test, not hang pytest with an orphaned broker."""
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(p.stdout, selectors.EVENT_READ)
+    buf = b""
+    import time
+    deadline = time.monotonic() + timeout_s
+    fd = p.stdout.fileno()
+    while time.monotonic() < deadline:
+        if not sel.select(timeout=0.2):
+            continue
+        chunk = os.read(fd, 4096)
+        if not chunk:
+            break
+        buf += chunk
+        if b"\n" in buf:
+            return buf.split(b"\n", 1)[0].decode()
+    p.kill()
+    raise AssertionError(f"no READY line within {timeout_s}s: {buf!r}")
+
+
+def _spawn(name, join=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the TPU relay
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_node.py"),
+           "--name", name, "--no-device"]
+    if join:
+        cmd += ["--join", join]
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None, env=env)
+    line = _readline_deadline(p, 60).strip()
+    assert line.startswith("READY "), f"node {name} failed to boot: {line}"
+    _, mqtt_port, rpc_port = line.split()
+    return p, int(mqtt_port), int(rpc_port)
+
+
+@pytest.fixture()
+def two_nodes():
+    a = b = None
+    try:
+        a = _spawn("a@127.0.0.1")
+        b = _spawn("b@127.0.0.1", join=f"127.0.0.1:{a[2]}")
+        yield a, b
+    finally:
+        for p in (x[0] for x in (a, b) if x):
+            p.send_signal(signal.SIGTERM)
+        for p in (x[0] for x in (a, b) if x):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_cross_process_pubsub(two_nodes):
+    (pa, mqtt_a, _), (pb, mqtt_b, _) = two_nodes
+
+    async def go():
+        from emqx_tpu.client import Client
+
+        sub = Client(port=mqtt_a, clientid="sub-a")
+        await sub.connect()
+        from emqx_tpu.mqtt import packet as P
+        await sub.subscribe([("x/cross/#", P.SubOpts(qos=0))])
+
+        pub = Client(port=mqtt_b, clientid="pub-b")
+        await pub.connect()
+        # replication is async: wait for the route to reach node B by
+        # publishing until delivery lands (bounded)
+        got = None
+        for i in range(100):
+            await pub.publish(f"x/cross/{i}", b"hello", qos=0)
+            try:
+                got = await asyncio.wait_for(sub.messages.get(), 0.2)
+                break
+            except asyncio.TimeoutError:
+                continue
+        assert got is not None, "cross-node delivery never arrived"
+        assert got.topic.startswith("x/cross/")
+        assert got.payload == b"hello"
+
+        # reverse direction: subscribe on B, publish on A
+        sub2 = Client(port=mqtt_b, clientid="sub-b")
+        await sub2.connect()
+        await sub2.subscribe([("y/back", P.SubOpts(qos=0))])
+        pub2 = Client(port=mqtt_a, clientid="pub-a")
+        await pub2.connect()
+        got2 = None
+        for _ in range(100):
+            await pub2.publish("y/back", b"rsvp", qos=0)
+            try:
+                got2 = await asyncio.wait_for(sub2.messages.get(), 0.2)
+                break
+            except asyncio.TimeoutError:
+                continue
+        assert got2 is not None and got2.payload == b"rsvp"
+
+        for c in (sub, pub, sub2, pub2):
+            await c.disconnect()
+
+    asyncio.run(go())
+
+
+def test_node_death_is_survivable(two_nodes):
+    """Killing B must leave A serving: its clients still pub/sub locally."""
+    (pa, mqtt_a, _), (pb, _mqtt_b, _) = two_nodes
+
+    async def go():
+        from emqx_tpu.client import Client
+        from emqx_tpu.mqtt import packet as P
+
+        pb.kill()
+        pb.wait(timeout=10)
+        await asyncio.sleep(0.2)
+
+        c = Client(port=mqtt_a, clientid="local-a")
+        await c.connect()
+        await c.subscribe([("alive/check", P.SubOpts(qos=1))])
+        await c.publish("alive/check", b"ping", qos=1)
+        got = await asyncio.wait_for(c.messages.get(), 5)
+        assert got.payload == b"ping"
+        await c.disconnect()
+
+    asyncio.run(go())

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Boot one broker node as an OS process (the two-node deployment shape
+the reference exercises with scripts/start-two-nodes-in-docker.sh).
+
+Usage:
+    python tools/run_node.py --name a@127.0.0.1 [--config etc/emqx.conf]
+        [--mqtt-port 0] [--rpc-port 0] [--join host:port] [--no-device]
+
+Prints one `READY <mqtt_port> <rpc_port>` line on stdout once serving,
+then runs until SIGTERM/SIGINT. A test harness (or an operator) parses
+that line to wire clients and cluster joins.
+"""
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", default="emqx_tpu@127.0.0.1")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--mqtt-port", type=int, default=0)
+    ap.add_argument("--rpc-port", type=int, default=0)
+    ap.add_argument("--join", default=None, help="seed node host:port")
+    ap.add_argument("--no-device", action="store_true")
+    args = ap.parse_args()
+
+    from emqx_tpu.broker.connection import Listener
+    from emqx_tpu.broker.node import Node
+    from emqx_tpu.cluster import ClusterNode
+
+    join_addr = None
+    if args.join:
+        host, sep, port = args.join.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            ap.error(f"--join expects host:port, got {args.join!r}")
+        join_addr = (host, int(port))
+
+    kw = {"use_device": False} if args.no_device else {}
+    if args.config:
+        if args.mqtt_port:
+            ap.error("--mqtt-port has no effect with --config "
+                     "(set the port in the config's listeners block)")
+        node = Node.from_config_file(args.config, name=args.name, **kw)
+        listeners = await node.start_listeners()
+        # advertise the first plain MQTT TCP listener (a ws/quic port
+        # would mislead a TCP harness)
+        tcp = [lst for lst in listeners if isinstance(lst, Listener)]
+        mqtt_port = tcp[0].port if tcp else 0
+    else:
+        node = Node(name=args.name, **kw)
+        lst = Listener(node, bind="127.0.0.1", port=args.mqtt_port)
+        await lst.start()
+        node.listeners.append(lst)
+        mqtt_port = lst.port
+
+    cn = ClusterNode(node, port=args.rpc_port)
+    await cn.start()
+    if join_addr:
+        await cn.join(*join_addr)
+
+    node.start_timers()
+    print(f"READY {mqtt_port} {cn.address[1]}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await cn.stop()
+    await node.stop_listeners()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
