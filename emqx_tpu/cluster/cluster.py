@@ -117,6 +117,10 @@ class ClusterNode:
         if dt is not None:      # etcd lease keepalive (discovery.py)
             dt.cancel()
             self._discovery_task = None
+        md = getattr(self, "_mcast_discovery", None)
+        if md is not None:      # stop answering group probes for a dead
+            md.stop_responder()  # RPC address (discovery.py mcast)
+            self._mcast_discovery = None
         if self._repl_task:
             try:
                 await asyncio.wait_for(self._repl_q.join(), 2)

@@ -6,9 +6,9 @@ strategies manual | static | mcast | dns | etcd | k8s, plus
 cluster_autoheal/cluster_autoclean which live in
 emqx_tpu/cluster/membership.py). Each strategy resolves to a list of
 (host, port) seed addresses; `autocluster` joins the local ClusterNode to
-every discovered peer. mcast is intentionally absent (removed in later
-reference versions; UDP multicast is unavailable in the target
-deployments) — static/dns/etcd/k8s cover the schema's practical set.
+every discovered peer, and registry/announce strategies (etcd, mcast)
+publish the local node first so cold-started clusters can find each
+other.
 """
 
 from __future__ import annotations
@@ -225,6 +225,139 @@ class K8sDiscovery(Discovery):
         return out
 
 
+class McastDiscovery(Discovery):
+    """UDP multicast probe/response (the ekka mcast strategy: addr +
+    ports + ttl + loop + iface, emqx_machine_schema cluster.mcast block).
+    Every node runs responders joined to the group on each configured
+    port; discover() multicasts a probe to every port and collects
+    unicast replies for `wait_s`. The reply carries the peer's
+    advertised RPC address, so the probe socket needs no group
+    membership of its own."""
+
+    strategy = "mcast"
+    _MAGIC = b"EMQXTPU-MCAST1"
+
+    def __init__(self, addr: str = "239.192.0.1", port=45369,
+                 cluster_name: str = "emqx_tpu", ttl: int = 1,
+                 loop_enable: bool = True, iface: str = "0.0.0.0",
+                 wait_s: float = 1.0):
+        self.addr = addr
+        self.ports = [int(p) for p in
+                      (port if isinstance(port, (list, tuple)) else [port])]
+        if not self.ports:
+            raise ValueError("mcast discovery needs at least one port")
+        self.port = self.ports[0]
+        self.cluster_name = cluster_name
+        self.ttl = ttl
+        self.loop_enable = loop_enable
+        self.iface = iface
+        self.wait_s = wait_s
+        self._responders: list[asyncio.DatagramTransport] = []
+
+    # one definition of the wire format — an exact-match compare on the
+    # responder side means any drift between builder copies silently
+    # breaks discovery
+    def _probe(self) -> bytes:
+        return self._MAGIC + b" PROBE " + self.cluster_name.encode()
+
+    def _reply_prefix(self) -> bytes:
+        return self._MAGIC + b" NODE " + self.cluster_name.encode() + b" "
+
+    def _mcast_opts(self, s) -> None:
+        import socket
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, self.ttl)
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP,
+                     1 if self.loop_enable else 0)
+        if self.iface != "0.0.0.0":
+            # multihomed host: transmit on the configured interface, not
+            # the default route
+            s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_IF,
+                         socket.inet_aton(self.iface))
+
+    def _group_sock(self, bind_port: int):
+        import socket
+        import struct
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if hasattr(socket, "SO_REUSEPORT"):  # several nodes per host
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind(("", bind_port))
+            mreq = struct.pack("4s4s", socket.inet_aton(self.addr),
+                               socket.inet_aton(self.iface))
+            s.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+            self._mcast_opts(s)
+            s.setblocking(False)
+            return s
+        except OSError:
+            s.close()
+            raise
+
+    async def start_responder(self, host: str, port: int) -> None:
+        """Join the group on every configured port and answer probes for
+        our cluster with the advertised RPC address. Idempotent."""
+        if self._responders:
+            return
+        probe = self._probe()
+        reply = self._reply_prefix() + f"{host}:{port}".encode()
+
+        class _Responder(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                if data == probe:
+                    self.transport.sendto(reply, addr)
+
+        loop = asyncio.get_running_loop()
+        for bind_port in self.ports:
+            transport, _ = await loop.create_datagram_endpoint(
+                _Responder, sock=self._group_sock(bind_port))
+            self._responders.append(transport)
+
+    def stop_responder(self) -> None:
+        for t in self._responders:
+            t.close()
+        self._responders = []
+
+    async def discover(self) -> list[tuple[str, int]]:
+        import socket
+        loop = asyncio.get_running_loop()
+        found: set[tuple[str, int]] = set()
+        want = self._reply_prefix()
+
+        class _Collector(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                if not data.startswith(want):
+                    return
+                hp = data[len(want):].decode(errors="replace")
+                h, _, p = hp.rpartition(":")
+                if h and p.isdigit():
+                    found.add((h, int(p)))
+
+            def error_received(self, exc):
+                # asyncio routes sendto OSErrors here, not to the caller
+                # (e.g. ENETUNREACH: no multicast route)
+                log.warning("mcast discovery failed: %s", exc)
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            self._mcast_opts(s)
+            s.setblocking(False)
+        except OSError:
+            s.close()
+            raise
+        transport, _ = await loop.create_datagram_endpoint(
+            _Collector, sock=s)
+        try:
+            for p in self.ports:
+                transport.sendto(self._probe(), (self.addr, p))
+            await asyncio.sleep(self.wait_s)
+        finally:
+            transport.close()
+        return sorted(found)
+
+
 def from_config(conf: dict,
                 resolver: Optional[Callable] = None) -> Discovery:
     """Build the configured strategy from the `cluster` config section
@@ -244,6 +377,18 @@ def from_config(conf: dict,
         return EtcdDiscovery(econf.get("server", "http://127.0.0.1:2379"),
                              econf.get("prefix", "emqxcl"),
                              conf.get("name", "emqx_tpu"))
+    if strategy == "mcast":
+        mconf = conf.get("mcast") or {}
+        ports = mconf.get("ports", 45369)
+        if isinstance(ports, list) and not ports:
+            raise ValueError("cluster.mcast.ports must not be empty")
+        return McastDiscovery(
+            addr=mconf.get("addr", "239.192.0.1"),
+            port=ports,
+            cluster_name=conf.get("name", "emqx_tpu"),
+            ttl=int(mconf.get("ttl", 1)),
+            loop_enable=bool(mconf.get("loop", True)),
+            iface=mconf.get("iface", "0.0.0.0"))
     if strategy == "k8s":
         kconf = conf.get("k8s") or {}
         return K8sDiscovery(
@@ -264,6 +409,11 @@ async def autocluster(cluster_node, discovery: Optional[Discovery] = None,
             cluster_node.node.config.get("cluster") or {},
             resolver=resolver)
     me = cluster_node.address
+    if isinstance(discovery, McastDiscovery):
+        # announce-style strategy: answer the group's probes from now on;
+        # ClusterNode.stop() closes the responder via this handle
+        await discovery.start_responder(me[0], me[1])
+        cluster_node._mcast_discovery = discovery
     if isinstance(discovery, EtcdDiscovery):
         # registry-style strategies need the local node published BEFORE
         # discovering, or a cold-started cluster finds nobody

@@ -114,7 +114,7 @@ class TestStrategies:
         assert from_config({"discovery": "etcd"}).strategy == "etcd"
         assert from_config({"discovery": "k8s"}).strategy == "k8s"
         with pytest.raises(ValueError):
-            from_config({"discovery": "mcast"})
+            from_config({"discovery": "carrier-pigeon"})
 
 
 class TestAutocluster:
@@ -204,3 +204,91 @@ class TestAutocluster:
                 await cn1.stop()
                 await seed.stop()
         run(loop, go())
+
+
+class TestMcast:
+    """ekka mcast strategy: responders joined to the group answer probes
+    with their advertised RPC address (loopback multicast)."""
+
+    def _can_mcast(self, loop):
+        """Loopback multicast needs a multicast-capable route; skip on
+        sandboxes without one."""
+        from emqx_tpu.cluster.discovery import McastDiscovery
+
+        async def go():
+            d = McastDiscovery(port=45370, cluster_name="probe-check",
+                               wait_s=0.05)
+            try:
+                await d.start_responder("127.0.0.1", 1)
+            except OSError:
+                return False
+            d.stop_responder()
+            return True
+        return run(loop, go())
+
+    def test_probe_finds_responders(self, loop):
+        from emqx_tpu.cluster.discovery import McastDiscovery
+        if not self._can_mcast(loop):
+            pytest.skip("no multicast-capable interface")
+
+        async def go():
+            r1 = McastDiscovery(port=45371, cluster_name="mc1", wait_s=0.3)
+            r2 = McastDiscovery(port=45371, cluster_name="mc1", wait_s=0.3)
+            other = McastDiscovery(port=45371, cluster_name="OTHER",
+                                   wait_s=0.3)
+            await r1.start_responder("10.0.0.1", 4370)
+            await r2.start_responder("10.0.0.2", 4371)
+            await other.start_responder("10.9.9.9", 9999)
+            try:
+                prober = McastDiscovery(port=45371, cluster_name="mc1",
+                                        wait_s=0.5)
+                seeds = await prober.discover()
+            finally:
+                for r in (r1, r2, other):
+                    r.stop_responder()
+            # both same-cluster responders answer; OTHER's never does
+            assert ("10.0.0.1", 4370) in seeds, seeds
+            assert ("10.0.0.2", 4371) in seeds, seeds
+            assert ("10.9.9.9", 9999) not in seeds, seeds
+        run(loop, go())
+
+    def test_autocluster_mcast_join(self, loop):
+        from emqx_tpu.cluster.discovery import McastDiscovery, autocluster
+        if not self._can_mcast(loop):
+            pytest.skip("no multicast-capable interface")
+
+        async def go():
+            na = Node(use_device=False, name="ma@127.0.0.1")
+            nb = Node(use_device=False, name="mb@127.0.0.1")
+            ca = ClusterNode(na, port=0, heartbeat_s=0.05)
+            cb = ClusterNode(nb, port=0, heartbeat_s=0.05)
+            await ca.start()
+            await cb.start()
+            try:
+                da = McastDiscovery(port=45372, cluster_name="mauto",
+                                    wait_s=0.4)
+                db = McastDiscovery(port=45372, cluster_name="mauto",
+                                    wait_s=0.4)
+                # A comes up first (finds nobody), then B finds A
+                assert await autocluster(ca, da) == 0
+                joined = await autocluster(cb, db)
+                assert joined == 1
+                await asyncio.sleep(0.2)
+                assert set(ca.membership.running_nodes()) == \
+                    {"ma@127.0.0.1", "mb@127.0.0.1"}
+                da.stop_responder()
+                db.stop_responder()
+            finally:
+                await ca.stop()
+                await cb.stop()
+        run(loop, go())
+
+    def test_from_config_mcast(self):
+        from emqx_tpu.cluster.discovery import McastDiscovery, from_config
+        d = from_config({"discovery": "mcast", "name": "c1",
+                         "mcast": {"addr": "239.192.0.5",
+                                   "ports": [45373], "ttl": 2,
+                                   "loop": True}})
+        assert isinstance(d, McastDiscovery)
+        assert (d.addr, d.port, d.ttl, d.cluster_name) == \
+            ("239.192.0.5", 45373, 2, "c1")

@@ -49,10 +49,15 @@ def _spawn(name, join=None):
     if join:
         cmd += ["--join", join]
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None, env=env)
-    line = _readline_deadline(p, 60).strip()
-    assert line.startswith("READY "), f"node {name} failed to boot: {line}"
-    _, mqtt_port, rpc_port = line.split()
-    return p, int(mqtt_port), int(rpc_port)
+    try:
+        line = _readline_deadline(p, 60).strip()
+        assert line.startswith("READY "), \
+            f"node {name} failed to boot: {line}"
+        _, mqtt_port, rpc_port = line.split()
+        return p, int(mqtt_port), int(rpc_port)
+    except BaseException:
+        p.kill()        # never orphan a half-booted broker
+        raise
 
 
 @pytest.fixture()
