@@ -642,6 +642,17 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             from emqx_tpu.broker.message import make
             warm = [make("w", 0, "warmup/none/t", b"") for _ in range(1024)]
             node.device_engine.route_batch(warm)
+            # ... and wait for the background window-class warm: its
+            # GIL-holding traces bill to setup here, exactly as a
+            # production broker warms before taking peak traffic (only
+            # shapes-backend snapshots ever fuse — a trie backend would
+            # spin this loop to its timeout for nothing)
+            eng = node.device_engine
+            if eng._built is not None and eng._built.backend == "shapes":
+                for _ in range(1200):
+                    if eng.max_fuse() > 1:
+                        break
+                    await asyncio.sleep(0.05)
 
         total = n_pub_conns * msgs_per_pub
         t0 = time.time()

@@ -150,6 +150,28 @@ def route_window_shapes(tables: ShapeRouterTables, cursors: jax.Array,
     return new_cursors, digests
 
 
+@functools.partial(jax.jit, static_argnames=("fanout_cap", "slot_cap"))
+def route_window_full(tables: ShapeRouterTables, cursors: jax.Array,
+                      topics: jax.Array, lens: jax.Array,
+                      is_dollar: jax.Array, msg_hash: jax.Array,
+                      strategy: jax.Array, *, fanout_cap: int = 128,
+                      slot_cap: int = 16) -> RouteResult:
+    """W fused route steps in ONE dispatch, returning the FULL stacked
+    RouteResult (every field [W, ...]) — the serving path's window
+    variant (route_window_shapes returns digests only, for benches).
+    Cursors thread through the scan exactly as W sequential calls, so
+    `new_cursors`/`occur` in row k reflect state after sub-batch k."""
+    def step(cur, batch):
+        t, l, d, h = batch
+        r = route_step_shapes(tables, cur, t, l, d, h, strategy,
+                              fanout_cap=fanout_cap, slot_cap=slot_cap)
+        return r.new_cursors, r
+
+    _, stacked = jax.lax.scan(
+        step, cursors, (topics, lens, is_dollar, msg_hash))
+    return stacked
+
+
 def empty_router_tables(filter_cap: int = 16) -> RouterTables:
     """A valid all-empty RouterTables (useful before first build)."""
     from emqx_tpu.ops.fanout import build_subtable

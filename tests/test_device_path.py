@@ -283,11 +283,16 @@ class TestAdaptiveDeviceChoice:
         b._since_probe = BM._PROBE_EVERY
         assert b._device_worth_it(4)
 
-    def test_ewma_clamps_outliers(self):
+    def test_ewma_pessimizes_fast_optimizes_slow(self):
+        """Cost estimates adopt a big upward surprise outright (staying
+        optimistic about a path that measured 3x slower keeps live
+        traffic on the slow path), but improve smoothly (one fast sample
+        must not hide a generally slow path — the probes re-measure)."""
         from emqx_tpu.broker.batcher import _ewma
         cur = 0.010
-        spiked = _ewma(cur, 30.0)           # cold-compile spike
-        assert spiked < 0.02                # clamped, not dominated
+        assert _ewma(cur, 30.0) == 30.0          # adopted, not clamped
+        fast = _ewma(cur, 0.001)                 # improvement is smooth
+        assert 0.005 < fast < cur
         assert _ewma(None, 0.5) == 0.5
 
 

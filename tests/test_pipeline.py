@@ -253,16 +253,25 @@ class TestBackgroundRebuild:
             return samples
 
         samples = run(go(), timeout=120)
-        # The build/upload/compile runs off the loop; the residual jitter is
-        # GIL handoff + GC while the build thread crunches (CPython
-        # scheduling, ~sys.getswitchinterval granularity) — rare one-off
-        # pauses in the tens of ms, vs the 16-SECOND inline stall this
-        # replaces (round-2 weak #7). Guard the design property: p95 < 10ms
-        # and nothing remotely like an inline build (< 150ms worst case).
+        # The build/upload/compile runs off the loop; the residual jitter
+        # is GIL handoff while the build thread TRACES each warm class
+        # (XLA tracing holds the GIL even on an executor thread — one
+        # ~10-25ms pause per class: three batch classes + the fused
+        # window class) plus GC/scheduling noise. That is the honest
+        # floor without process isolation, vs the 16-SECOND inline stall
+        # this replaces (round-2 weak #7). Guard the design property:
+        # pauses are RARE one-offs (bounded by the class count), the
+        # median tick is clean, and nothing remotely like an inline
+        # build happens (< 150ms worst case).
         assert samples, "heartbeat never ran"
         over = [s for s in samples if s >= 0.010]
-        assert len(over) <= max(2, len(samples) // 20), \
+        # constant bound: the pauses are one-per-warm-class, NOT a
+        # fraction of ticks — a percentage allowance would let a real
+        # stall regression scale with the sample count
+        assert len(over) <= 6, \
             f"frequent stalls: {[round(s*1e3,1) for s in over][:10]}ms"
+        assert sorted(samples)[len(samples) // 2] < 0.005, \
+            "median heartbeat tick degraded"
         assert max(samples) < 0.150, \
             f"rebuild stalled the loop {max(samples)*1e3:.1f}ms"
 
@@ -312,3 +321,100 @@ class TestAdaptiveProbes:
         bt._since_host_probe = bt.host_probe_every
         # due a host probe even though the device looks cheap
         assert not bt._device_worth_it(4)
+
+
+class TestWindowFusion:
+    """Sustained backlog fuses consecutive batches into ONE device
+    dispatch (route_window_full) — the serving-path analog of bench.py's
+    BENCH_FUSE amortization."""
+
+    def test_backlog_fuses_and_orders(self):
+        node = Node()
+        bt = node.publish_batcher
+        bt.window_s = 0.0005
+        bt.max_batch = 16          # small batches force fusion pressure
+        b = node.broker
+        sink = Sink()
+        sid = b.register(sink, "c1")
+        b.subscribe(sid, "wf/#", {"qos": 0})
+
+        real_dispatch = node.device_engine.dispatch
+
+        def slow_dispatch(h):
+            time.sleep(0.01)       # backlog builds while dispatch runs
+            real_dispatch(h)
+
+        node.device_engine.dispatch = slow_dispatch
+        # pin the routing choice: the adaptive chooser would (correctly)
+        # bypass this artificially slow device — fusion is what's under
+        # test here, not the chooser (TestAdaptiveProbes covers that)
+        bt._device_worth_it = lambda n, n_subs=1: True
+
+        async def go():
+            # warm the snapshot + window compile classes
+            await asyncio.gather(*[
+                node.publish_async(mkmsg(f"wf/w{i}")) for i in range(8)])
+            # fusion only engages once the window classes are compiled
+            # (cold compiles must never run in the serving path)
+            for _ in range(1200):
+                if node.device_engine.max_fuse() >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            assert node.device_engine.max_fuse() >= 4, "fuse warm stalled"
+            n0_w = node.metrics.val("routing.device.windows")
+            n0_s = node.metrics.val("routing.device.window_subs")
+            # flood: enqueue (fire-and-forget) so one connection's stream
+            # piles a deep backlog for the fuser
+            for i in range(400):
+                assert bt.enqueue(mkmsg(f"wf/m{i:04d}"))
+            for _ in range(600):
+                await asyncio.sleep(0.01)
+                if len(sink.got) >= 408:
+                    break
+            return (node.metrics.val("routing.device.windows") - n0_w,
+                    node.metrics.val("routing.device.window_subs") - n0_s)
+
+        windows, subs = run(go())
+        assert len(sink.got) == 408
+        # fusion actually happened: more sub-batches than dispatches
+        assert windows >= 1 and subs > windows, (windows, subs)
+        # per-publisher order is preserved through fused windows
+        seq = [t for t in sink.got if t.startswith("wf/m")]
+        assert seq == sorted(seq)
+
+    def test_window_dispatch_failure_falls_back_host(self):
+        """A dispatch error fails the WHOLE window over to the host path:
+        every message still delivers exactly once, in order."""
+        node = Node()
+        bt = node.publish_batcher
+        bt.window_s = 0.0005
+        bt.max_batch = 8
+        b = node.broker
+        sink = Sink()
+        sid = b.register(sink, "c1")
+        b.subscribe(sid, "fb/#", {"qos": 0})
+
+        async def go():
+            await asyncio.gather(*[
+                node.publish_async(mkmsg(f"fb/w{i}")) for i in range(8)])
+
+            def boom(h):
+                raise RuntimeError("relay died")
+
+            node.device_engine.dispatch = boom
+            # pin the choice: the chooser would bypass an unmeasurable
+            # device; the failure path is what's under test
+            bt._device_worth_it = lambda n, n_subs=1: True
+            for i in range(100):
+                assert bt.enqueue(mkmsg(f"fb/m{i:03d}"))
+            for _ in range(600):
+                await asyncio.sleep(0.01)
+                if len(sink.got) >= 108:
+                    break
+            assert node.metrics.val(
+                "routing.device.dispatch_failed") >= 1
+
+        run(go())
+        assert len(sink.got) == 108
+        seq = [t for t in sink.got if t.startswith("fb/m")]
+        assert seq == sorted(seq)
