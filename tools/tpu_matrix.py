@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement matrix: everything round 3 needs from a
+single working relay window, in ONE process (concurrent TPU processes
+wedge the pool — see .claude/skills/verify/SKILL.md).
+
+Covers, in order of importance:
+  1. per-stage profile of the fused step at bench scale (profile_step)
+  2. fold backends: xla vs lane-major pallas (match-only window)
+  3. rank-scan block-width sweep (the sort-free kernel's knob)
+  4. fuse-width sweep (per-dispatch overhead amortization curve)
+
+Prints a JSON summary line at the end; everything logs to stderr as it
+goes so a killed run still leaves partial numbers.
+
+Usage: python tools/tpu_matrix.py [subs] [batch]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 131072
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import (device_filter_set, device_topic_batch,
+                       make_window_runner, put_tree_chunked, _put_retry)
+    from emqx_tpu.models.router_engine import ShapeRouterTables
+    from emqx_tpu.ops.fanout import SubTable
+    from emqx_tpu.ops.shapes import (build_shape_tables, shape_match,
+                                     shape_match_pallas)
+    from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+
+    out = {"subs": subs, "batch": B, "device": str(jax.devices()[0])}
+    log(f"matrix: {out}")
+
+    fs = device_filter_set(subs)
+    t0 = time.time()
+    shapes = build_shape_tables(fs["rows"], fs["lens"])
+    out["table_build_s"] = round(time.time() - t0, 2)
+    log(f"build {out['table_build_s']}s")
+
+    F = fs["ids"] * fs["nums"]
+    n_shared = F // 2
+    group_of = np.arange(n_shared, dtype=np.int32) // 16
+    n_groups = max(1, int(group_of.max(initial=0)) + 1)
+    fs_start = np.zeros(F + 1, np.int32)
+    fs_start[1:n_shared + 1] = 1
+    np.cumsum(fs_start, out=fs_start)
+    subs_tbl = SubTable(
+        np.arange(F + 1, dtype=np.int32), np.arange(F, dtype=np.int32),
+        np.ones(F, np.int8), fs_start,
+        group_of if n_shared else np.full(1, -1, np.int32),
+        np.arange(n_groups + 1, dtype=np.int32) * 8,
+        F + np.arange(n_groups * 8, dtype=np.int32),
+        np.ones(n_groups * 8, np.int8))
+    tables = put_tree_chunked(ShapeRouterTables(shapes=shapes,
+                                                subs=subs_tbl))
+    jax.block_until_ready(tables)
+    cursors0 = _put_retry(np.zeros(n_groups, np.int32))
+    strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
+    rng = np.random.RandomState(7)
+    staged = []
+    for _ in range(8):
+        tp, tl = device_topic_batch(fs, rng, B)
+        staged.append((_put_retry(tp), _put_retry(tl),
+                       _put_retry(np.zeros(B, bool)),
+                       _put_retry(rng.randint(0, 1 << 30, B)
+                                  .astype(np.int32))))
+    log("staged")
+
+    # ---- 2. fold backends --------------------------------------------
+    def match_window(fn, n=16):
+        acc = _put_retry(np.int32(0))
+        t0 = time.time()
+        for i in range(n):
+            t_, l_, d_, _ = staged[i % 8]
+            r = fn(tables.shapes, t_, l_, d_)
+            acc = acc + r.matches.sum(dtype=jnp.int32)
+        _ = int(np.asarray(acc))
+        return B * n / (time.time() - t0)
+
+    try:
+        rx = shape_match(tables.shapes, *staged[0][:3])
+        rp = shape_match_pallas(tables.shapes, *staged[0][:3])
+        out["pallas_bit_identical"] = bool(
+            (np.asarray(rx.matches) == np.asarray(rp.matches)).all())
+        match_window(shape_match, 2)
+        match_window(shape_match_pallas, 2)
+        out["match_xla_per_s"] = round(match_window(shape_match))
+        out["match_pallas_per_s"] = round(match_window(shape_match_pallas))
+        log(f"fold: xla {out['match_xla_per_s']/1e6:.1f}M/s "
+            f"pallas {out['match_pallas_per_s']/1e6:.1f}M/s "
+            f"identical={out['pallas_bit_identical']}")
+    except Exception as e:  # noqa: BLE001
+        out["pallas_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        log("pallas failed:", out["pallas_error"])
+
+    # ---- 4. fuse-width sweep (also yields the headline number) -------
+    out["fuse_sweep"] = {}
+    for fuse in (1, 2, 4, 8):
+        stacked = tuple(jnp.stack([staged[k % 8][i] for k in range(fuse)])
+                        for i in range(4))
+        run = make_window_runner(tables, cursors0, strat, stacked, 4, 2)
+        run(1)
+        n_calls = max(1, 32 // fuse)
+        dt = run(n_calls)
+        per_s = B * fuse * n_calls / dt
+        out["fuse_sweep"][str(fuse)] = round(per_s)
+        log(f"fuse={fuse}: {per_s/1e6:.2f}M matches/s "
+            f"({dt/ (n_calls*fuse) * 1000:.2f}ms/batch)")
+    out["value"] = max(out["fuse_sweep"].values())
+
+    # ---- 3. rank block sweep is env-driven; report current ----------
+    out["rank_block"] = int(os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
