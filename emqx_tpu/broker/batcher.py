@@ -192,8 +192,19 @@ class PublishBatcher:
                     # fused window
                     dispatched = False
                     use_device = (bool(live0) and self.engine is not None
-                                  and len(live0) >= self.device_min_batch
-                                  and self._device_worth_it(len(live0)))
+                                  and len(live0) >= self.device_min_batch)
+                    if use_device \
+                            and not self.engine.batch_class_warm(
+                                len(live0)):
+                        # the class would cold-compile in the dispatch
+                        # path: route host-side and let the background
+                        # warm bring the device online (observed: 5s+
+                        # first-ack latency under a cold-start flood)
+                        self.engine._kick_class_warm()
+                        self.node.metrics.inc("routing.device.cold_class")
+                        use_device = False
+                    use_device = use_device \
+                        and self._device_worth_it(len(live0))
                     if use_device:
                         # window fusion: sustained backlog folds further
                         # batches into the SAME device dispatch — capped

@@ -159,11 +159,14 @@ class DeviceRouteEngine:
         # per-filter cluster shared-group union, invalidated on membership
         # change (avoids per-message set unions on the consume path)
         self._cluster_groups_cache: dict[str, tuple] = {}
-        # window fusion readiness: serving only fuses when the CURRENT
-        # snapshot's fused window class has been jit-compiled — a cold
-        # window-class compile in the serving path stalls live traffic
-        # for seconds (observed: e2e collapse on first fused flood)
-        self._warm_sigs: set = set()
+        # compile-class readiness: the BATCHER only routes a batch to
+        # the device when its (W, Bp) class is known-warm for the current
+        # snapshot signature — an in-path XLA compile stalls live
+        # traffic for seconds (observed: 5s+ first-QoS1-ack under a
+        # cold-start flood). Classes become warm via background warm
+        # tasks or any successful dispatch (route_batch warmups).
+        self._warm_classes: set = set()      # {(sig, W, Bp)}
+        self._extra_classes: set = set()     # non-standard (W, Bp) wanted
         self._cur_sig: tuple = ()
         self._fuse_warm_task = None
         # background rebuild machinery (round-2 weak #7)
@@ -495,8 +498,7 @@ class DeviceRouteEngine:
         from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
         b, tables, cursors, _rich = result
         strat = np.int32(STRATEGY_ROUND_ROBIN)
-        classes = [(1, 64), (1, 256), (1, 1024), (8, 1024)]
-        for Wp, Bp in classes:
+        for Wp, Bp in self._STD_CLASSES:
             if Wp > 1 and b.backend != "shapes":
                 continue    # trie backend never fuses: (8, Bp) would
                             # just redundantly re-run the (1, Bp) step
@@ -518,10 +520,13 @@ class DeviceRouteEngine:
                                slot_cap=self.slot_cap)
             jax.block_until_ready(r.match_counts)
         if b.backend == "shapes":
-            # this snapshot's window class is warm: once IT is serving,
-            # the path may fuse (readiness is per shape signature, so an
-            # old snapshot still serving cannot fuse into cold shapes)
-            self._warm_sigs.add(self._tables_sig(tables))
+            # this snapshot's classes are warm: once IT is serving, the
+            # batcher may dispatch/fuse (readiness is per shape
+            # signature, so an old snapshot still serving cannot run
+            # into cold shapes)
+            sig = self._tables_sig(tables)
+            for Wp, Bp in self._STD_CLASSES:
+                self._warm_classes.add((sig, Wp, Bp))
 
     def _try_swap(self) -> None:
         """Apply a finished background build if no dispatch is in flight
@@ -580,20 +585,50 @@ class DeviceRouteEngine:
         now: 1 until the CURRENT snapshot's fused window class is warm,
         then the largest class. Trie-backend snapshots never fuse (no
         window program — sequential dispatch amortizes nothing)."""
+        W = self._W_CLASSES[-1]
         if self._built is None or self._built.backend != "shapes" \
-                or self._cur_sig not in self._warm_sigs:
+                or (self._cur_sig, W, 1024) not in self._warm_classes:
             return 1
-        return self._W_CLASSES[-1]
+        return W
 
-    def _kick_fuse_warm(self) -> None:
-        """Warm the fused (W=8, Bp=1024) window class for the CURRENT
-        snapshot off the serving path, then raise the fuse ceiling (by
-        registering the snapshot's shape signature). Re-kicks after a
-        failure and after any swap to unwarmed capacity classes."""
+    def batch_class_warm(self, n_msgs: int) -> bool:
+        """True when a single batch of n_msgs would dispatch into an
+        already-compiled (1, Bp) class for the CURRENT snapshot — the
+        batcher routes host-side (and kicks the background warm)
+        otherwise, so serving never stalls on an XLA compile."""
+        if self._built is None:
+            return False
+        if self._built.backend != "shapes":
+            # trie backend has no background warm path for every class;
+            # first use compiles in-path as it always has (rare fallback)
+            return True
+        for Bp in (64, 256, 1024):
+            if n_msgs <= Bp:
+                break
+        else:
+            Bp = _next_pow2(n_msgs)
+        if (self._cur_sig, 1, Bp) in self._warm_classes:
+            return True
+        if Bp > 1024:
+            # oversized batch class (max_publish_batch > 1024): queue it
+            # for the background warm, or it would be locked out forever
+            self._extra_classes.add((1, Bp))
+        return False
+
+    _STD_CLASSES = ((1, 64), (1, 256), (1, 1024), (8, 1024))
+
+    def _kick_class_warm(self) -> None:
+        """Warm every standard (W, Bp) class the CURRENT snapshot is
+        missing, off the serving path. Re-kicks after a failure and
+        after any swap to unwarmed capacity classes."""
         import asyncio
         if self._fuse_warm_task is not None or self._built is None \
-                or self._built.backend != "shapes" \
-                or self._cur_sig in self._warm_sigs:
+                or self._built.backend != "shapes":
+            return
+        wanted = self._STD_CLASSES + tuple(sorted(self._extra_classes))
+        missing = [(W, Bp) for W, Bp in wanted
+                   if (self._cur_sig, W, Bp) not in self._warm_classes]
+        if not missing:
             return
         try:
             loop = asyncio.get_running_loop()
@@ -608,28 +643,29 @@ class DeviceRouteEngine:
             from emqx_tpu.models.router_engine import route_window_full
             from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
             strat = np.int32(STRATEGY_ROUND_ROBIN)
-            Wp = self._W_CLASSES[-1]
-            enc = np.zeros((Wp, 1024, self.max_levels), np.int32)
-            z = np.zeros((Wp, 1024), np.int32)
-            r = route_window_full(
-                tables, cursors, enc, z,
-                np.zeros((Wp, 1024), bool), z, strat,
-                fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
-            jax.block_until_ready(r.match_counts)
-            self._warm_sigs.add(sig)
+            for Wp, Bp in missing:
+                enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
+                z = np.zeros((Wp, Bp), np.int32)
+                r = route_window_full(
+                    tables, cursors, enc, z, np.zeros((Wp, Bp), bool),
+                    z, strat, fanout_cap=self.fanout_cap,
+                    slot_cap=self.slot_cap)
+                jax.block_until_ready(r.match_counts)
+                self._warm_classes.add((sig, Wp, Bp))
 
         async def run():
             try:
                 await loop.run_in_executor(None, warm)
-            except Exception:  # noqa: BLE001 — fusion stays off, retry
+            except Exception:  # noqa: BLE001 — classes stay cold, retry
                 import logging
                 logging.getLogger("emqx.device").exception(
-                    "window warm-compile failed; fusion disabled until "
-                    "the next attempt")
+                    "class warm-compile failed; affected classes stay "
+                    "host-routed until the next attempt")
             finally:
                 self._fuse_warm_task = None
 
         self._fuse_warm_task = loop.create_task(run())
+
 
     def prepare_window(self, lives: list[list[Message]]):
         """Stage 1 (event loop): encode 1..W micro-batches as one fused
@@ -644,7 +680,7 @@ class DeviceRouteEngine:
         self.poll_rebuild()
         if self._built is None or not lives:
             return None
-        self._kick_fuse_warm()
+        self._kick_class_warm()
         b = self._built
         from emqx_tpu.ops.match import encode_topics
         subs = []
@@ -759,6 +795,7 @@ class DeviceRouteEngine:
                 np.int32(strat_id), fanout_cap=self.fanout_cap,
                 slot_cap=self.slot_cap)
             self._cursors = res.new_cursors[-1]
+            self._warm_classes.add((self._cur_sig, Wp, Bp))
         else:
             # trie backend has no window variant: dispatch sub-batches
             # sequentially (rare path — >SHAPE_CAP distinct shapes)

@@ -230,6 +230,15 @@ class TestEndToEnd:
                     c = Client(port=listener.port, clientid=f"pub{i}")
                     await c.connect()
                     pubs.append(c)
+                # wait until the device path engages (compile classes
+                # warm in the background; the batcher routes host-side
+                # meanwhile) — raises if it never does
+                from tests.test_pipeline import _await_device_engaged
+                await _await_device_engaged(node, "warm/{}")
+                # pin the choice for the asserted batch (the chooser may
+                # legitimately bypass tiny batches on this backend)
+                node.publish_batcher._device_worth_it = \
+                    lambda n, n_subs=1: True
                 # concurrent QoS1 publishes land in one batch window
                 await asyncio.gather(*[
                     c.publish(f"bench/{i}/t", b"p%d" % i, qos=1)
