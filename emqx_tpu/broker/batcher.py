@@ -168,9 +168,11 @@ class PublishBatcher:
                 # already full
                 if len(self._queue) < self.max_batch and self.window_s > 0:
                     await asyncio.sleep(self.window_s)
-                def form_entry():
+                def form_entry(cap=None):
+                    limit = min(self.max_batch, cap) if cap else \
+                        self.max_batch
                     batch = []
-                    while self._queue and len(batch) < self.max_batch:
+                    while self._queue and len(batch) < limit:
                         batch.append(self._queue.popleft())
                     return {"batch": batch, "handle": None, "sub": 0,
                             "dispatch_fut": None, "live": None,
@@ -211,13 +213,24 @@ class PublishBatcher:
                         # at the largest already-compiled window class
                         # (a cold window compile would stall serving)
                         # and the slow-start width
-                        fuse_cap = min(self.window_fuse,
-                                       self.engine.max_fuse(),
-                                       self._fuse_cwnd)
+                        # fusion runs only in the warmed (8, Bstd)
+                        # class: a FIRST batch beyond the largest
+                        # standard class (max_publish_batch > Bstd and a
+                        # deep backlog) dispatches as a single window via
+                        # its extra class, but ordinary batches still
+                        # fuse — so raising max_publish_batch for burst
+                        # headroom does not silently disable fusion
+                        b_std = self.engine._STD_CLASSES[-1][1]
+                        fuse_cap = 1 if len(live0) > b_std else \
+                            min(self.window_fuse,
+                                self.engine.max_fuse(),
+                                self._fuse_cwnd)
                         while (len(group) < fuse_cap
                                and len(self._queue)
                                >= self.device_min_batch):
-                            e2 = form_entry()
+                            # later sub-batches must stay inside the
+                            # window class too
+                            e2 = form_entry(cap=b_std)
                             await self._fold_hooks(e2)
                             group.append(e2)
                     lives = [e["live"] for e in group if e["live"]]

@@ -401,6 +401,11 @@ class DeviceRouteEngine:
             self.rich_filters = rich
             self._cur_sig = self._tables_sig(tables) \
                 if b.backend == "shapes" else ()
+            # evict warmth of superseded signatures (unbounded set
+            # otherwise under churn); a re-warm for a returning capacity
+            # class is a jit-cache hit, not a fresh trace
+            self._warm_classes = {e for e in self._warm_classes
+                                  if e[0] == self._cur_sig}
         # replay churn that raced the build: journaled note_* calls are
         # idempotent against the fresh snapshot (worst case marks a filter
         # that the build already captured as dirty — correct, just host-side
@@ -585,11 +590,19 @@ class DeviceRouteEngine:
         now: 1 until the CURRENT snapshot's fused window class is warm,
         then the largest class. Trie-backend snapshots never fuse (no
         window program — sequential dispatch amortizes nothing)."""
-        W = self._W_CLASSES[-1]
+        W, Bp = self._STD_CLASSES[-1]
         if self._built is None or self._built.backend != "shapes" \
-                or (self._cur_sig, W, 1024) not in self._warm_classes:
+                or (self._cur_sig, W, Bp) not in self._warm_classes:
             return 1
         return W
+
+    def _batch_class(self, n_msgs: int) -> int:
+        """Quantize a batch size onto the standard Bp ladder (derived
+        from _STD_CLASSES), or the next pow2 beyond it."""
+        for _w, Bp in self._STD_CLASSES:
+            if _w == 1 and n_msgs <= Bp:
+                return Bp
+        return _next_pow2(n_msgs)
 
     def batch_class_warm(self, n_msgs: int) -> bool:
         """True when a single batch of n_msgs would dispatch into an
@@ -602,14 +615,10 @@ class DeviceRouteEngine:
             # trie backend has no background warm path for every class;
             # first use compiles in-path as it always has (rare fallback)
             return True
-        for Bp in (64, 256, 1024):
-            if n_msgs <= Bp:
-                break
-        else:
-            Bp = _next_pow2(n_msgs)
+        Bp = self._batch_class(n_msgs)
         if (self._cur_sig, 1, Bp) in self._warm_classes:
             return True
-        if Bp > 1024:
+        if Bp > self._STD_CLASSES[-1][1]:
             # oversized batch class (max_publish_batch > 1024): queue it
             # for the background warm, or it would be locked out forever
             self._extra_classes.add((1, Bp))
@@ -693,17 +702,12 @@ class DeviceRouteEngine:
                 self.max_levels)
             subs.append((msgs, words_list, too_long))
             encs.append((enc, lens, dollar))
-            for c in (64, 256, 1024):
-                if len(msgs) <= c:
-                    Bp = max(Bp, c)
-                    break
-            else:
-                Bp = max(Bp, _next_pow2(len(msgs)))
+            Bp = max(Bp, self._batch_class(len(msgs)))
         if len(lives) > 1:
-            # fused windows run ONLY in the warmed (W=8, Bp=1024) class:
-            # any other (W, Bp) pair would cold-compile on the serving
+            # fused windows run ONLY in the warmed (W, Bp) top standard
+            # class: any other pair would cold-compile on the serving
             # path (padding compute is the price of never stalling)
-            Bp = max(Bp, 1024)
+            Bp = max(Bp, self._STD_CLASSES[-1][1])
         for Wp in self._W_CLASSES:
             if len(lives) <= Wp:
                 break

@@ -98,6 +98,12 @@ class _Channel:
         if self._reader_task:      # stale reader from a dead connection must
             self._reader_task.cancel()   # not fail the new one's futures
             self._reader_task = None
+        # abandoning the old connection means its in-flight calls can
+        # never be answered: fail them NOW. (The cancelled stale reader
+        # skips its own cleanup via the current-task guard, so without
+        # this, a racing call whose send beat the reconnect would park
+        # for its full timeout when this connect() fails.)
+        self._fail_pending(RpcError("connection closed"))
         self.reader, self.writer = await asyncio.open_connection(
             self.host, self.port)
         self.writer.write(encode_frame(
@@ -105,19 +111,46 @@ class _Channel:
         await self.writer.drain()
         ack = await read_frame(self.reader)
         if not ack or ack.get("t") != "hello_ok":
+            # close the fresh writer or the channel is left half-open
+            # (alive with no reader) and the NEXT call parks for its
+            # full timeout instead of re-failing fast
+            self.writer.close()
             raise RpcError(f"handshake rejected by {self.host}:{self.port}")
         self._reader_task = asyncio.create_task(self._read_loop())
 
     async def _read_loop(self) -> None:
-        while True:
-            msg = await read_frame(self.reader)
-            if msg is None:
-                break
-            if msg.get("t") == "reply":
-                fut = self._pending.pop(msg["id"], None)
-                if fut is not None and not fut.done():
-                    fut.set_result(msg)
-        self._fail_pending(RpcError("connection closed"))
+        # EVERY exit path — clean EOF (FIN), connection reset (RST: a
+        # peer SIGKILLed with unread data), or any codec error — must
+        # close OUR writer and fail the pending calls. An unhandled RST
+        # used to kill this task silently, leaving the channel half-open:
+        # `alive` still passed, the next call()'s write landed in a dead
+        # socket, and its future parked for the full timeout — observed
+        # as a CONNECT stalling ~35s on the clientid lock right after a
+        # peer was killed (pre-nodedown-detection window).
+        try:
+            while True:
+                msg = await read_frame(self.reader)
+                if msg is None:
+                    break
+                if msg.get("t") == "reply":
+                    fut = self._pending.pop(msg["id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except Exception:  # noqa: BLE001 — reset/codec: same cleanup
+            pass
+        finally:
+            # a STALE reader cancelled by a reconnect must not touch the
+            # NEW connection's state. connect() cancels the old task and
+            # nulls _reader_task with NO await between the two, so by the
+            # time the cancelled reader's finally runs, this guard is
+            # False exactly for it (do not insert an await there)
+            if self._reader_task is asyncio.current_task():
+                if self.writer is not None:
+                    try:
+                        self.writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._fail_pending(RpcError("connection closed"))
 
     def _fail_pending(self, err: Exception) -> None:
         for fut in self._pending.values():
@@ -141,9 +174,18 @@ class _Channel:
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[rid] = fut
+        data = encode_frame({"t": "call", "id": rid, "fn": fn, "args": args})
         try:
-            await self.send({"t": "call", "id": rid, "fn": fn, "args": args})
+            # register the future only once the connection is up, under
+            # the send lock: connect() fails every pending future (they
+            # belong to the dead connection), so registering earlier
+            # would let our own reconnect kill this call
+            async with self._lock:
+                if not self.alive:
+                    await self.connect()
+                self._pending[rid] = fut
+                self.writer.write(data)
+                await self.writer.drain()
             reply = await asyncio.wait_for(fut, timeout)
         except (asyncio.TimeoutError, ConnectionError, OSError) as e:
             self._pending.pop(rid, None)

@@ -563,3 +563,41 @@ async def _test_device_shared_local_groups():
         assert len(rc.msgs) >= 1, "remote member never picked"
     finally:
         await teardown(clusters)
+
+
+def test_rpc_half_open_channel_fails_fast(loop):
+    run(loop, _test_rpc_half_open())
+
+
+async def _test_rpc_half_open():
+    """A peer that dies between calls must NOT park the next call for its
+    full timeout: the EOF closes our writer too, so the next call
+    reconnects (refused) and raises RpcError promptly. Regression: a
+    half-open channel stalled CONNECT ~35s on the clientid lock right
+    after a peer was killed (pre-nodedown-detection window)."""
+    import time
+    a = RpcNode("a@x", port=0)
+    b = RpcNode("b@x", port=0)
+
+    async def echo(x):
+        return {"echo": x}
+
+    b.register("echo", echo)
+    await a.start()
+    await b.start()
+    try:
+        a.add_peer("b@x", *b.address)
+        # pin both calls to ONE channel (key hash): without it the retry
+        # would land on a random channel of the pool and only exercise
+        # the stale one ~1/4 of the time
+        assert (await a.call("b@x", "echo", [1], key="k"))["echo"] == 1
+        # kill b abruptly; give a's read loop a beat to process the EOF
+        await b.stop()
+        await asyncio.sleep(0.1)
+        t0 = time.time()
+        with pytest.raises(RpcError):
+            await a.call("b@x", "echo", [2], key="k", timeout=30)
+        assert time.time() - t0 < 2, "half-open channel parked the call"
+    finally:
+        await a.stop()
+        await b.stop()

@@ -13,9 +13,15 @@ that line to wire clients and cluster joins.
 
 import argparse
 import asyncio
+import faulthandler
 import os
 import signal
 import sys
+
+# SIGUSR1 dumps every thread's stack to stderr — the first tool to reach
+# for when a node stops answering (a wedged loop can't be introspected
+# any other way from outside)
+faulthandler.register(signal.SIGUSR1)
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -71,6 +77,34 @@ async def main() -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
+
+    def dump_tasks():
+        print(f"=== {len(asyncio.all_tasks(loop))} tasks ===",
+              file=sys.stderr)
+        for t in asyncio.all_tasks(loop):
+            print(f"--- {t.get_name()}", file=sys.stderr)
+            # walk the await chain (get_stack only shows the outer frame)
+            obj = t.get_coro()
+            depth = 0
+            while obj is not None and depth < 40:
+                fr = getattr(obj, "cr_frame", None) or \
+                    getattr(obj, "gi_frame", None)
+                if fr is not None:
+                    print(f"    {fr.f_code.co_filename}:{fr.f_lineno} "
+                          f"{fr.f_code.co_name}", file=sys.stderr)
+                nxt = getattr(obj, "cr_await", None) or \
+                    getattr(obj, "gi_yieldfrom", None)
+                if nxt is None:
+                    print(f"    -> awaiting {obj!r}"
+                          if fr is None else f"    -> leaf {obj!r}",
+                          file=sys.stderr)
+                obj = nxt
+                depth += 1
+        sys.stderr.flush()
+
+    # SIGUSR2 dumps every asyncio task's await stack (faulthandler's
+    # SIGUSR1 shows threads, but a PARKED coroutine is invisible there)
+    loop.add_signal_handler(signal.SIGUSR2, dump_tasks)
     await stop.wait()
     await cn.stop()
     await node.stop_listeners()
