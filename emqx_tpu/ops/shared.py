@@ -63,22 +63,26 @@ if _RANK_BLOCK < 8:
         f"EMQX_TPU_RANK_BLOCK must be >= 8, got {_RANK_BLOCK}")
 
 
-def _rank_and_occur_blocked(sids: jax.Array, n_slots: int):
+def _rank_and_occur_blocked(sids: jax.Array, n_slots: int,
+                            block: int | None = None):
     """Sort-free rank/occur for TPU (round-3): the round-2 argsort of the
     whole flattened batch measured as the fused step's dominant cost
     (~2/3 of the batch time; TPU sorts are bitonic-network expensive).
-    The flat array is scanned in _RANK_BLOCK-wide blocks: within a block,
-    rank is a strictly-lower-triangular equality reduction (one [L, L]
-    compare + masked row-sum on the VPU — the associative formulation of
-    SURVEY §7 hard-part 4); across blocks a per-slot count table is
-    carried, gathered for the block's base and advanced with a
-    unique-index scatter at each slot's LAST in-block occurrence. The
-    carried table's final state IS `occur`.
+    The flat array is scanned in `block`-wide blocks (default
+    _RANK_BLOCK; static — a sweep jits one program per width): within a
+    block, rank is a strictly-lower-triangular equality reduction (one
+    [L, L] compare + masked row-sum on the VPU — the associative
+    formulation of SURVEY §7 hard-part 4); across blocks a per-slot
+    count table is carried, gathered for the block's base and advanced
+    with a unique-index scatter at each slot's LAST in-block occurrence.
+    The carried table's final state IS `occur`.
     """
     B, K = sids.shape
     flat = sids.reshape(-1)
     n = flat.shape[0]
-    L = _RANK_BLOCK
+    L = _RANK_BLOCK if block is None else block
+    if L < 8:
+        raise ValueError(f"rank block width must be >= 8, got {L}")
     nb = -(-n // L)
     pad = nb * L - n
     blocks = jnp.pad(flat, (0, pad), constant_values=-1).reshape(nb, L)

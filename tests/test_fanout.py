@@ -186,6 +186,24 @@ class TestRankOccurOracle:
             want_occur = np.bincount(flat[flat >= 0], minlength=G)
             assert (occur == want_occur).all()
 
+    @pytest.mark.parametrize("block", [8, 32, 256])
+    def test_blocked_any_width(self, block):
+        """The block width is a sweepable static arg (tpu_matrix sweeps
+        it on hardware); every width must agree with the sorted impl,
+        including widths that leave a ragged final block."""
+        import numpy as np
+
+        from emqx_tpu.ops import shared as S
+        rng = np.random.RandomState(11)
+        B, K, G = 37, 3, 13          # B*K not a multiple of any block
+        sids = rng.randint(-1, G, size=(B, K)).astype(np.int32)
+        want_rank, want_occur = S._rank_and_occur_sorted(sids, G)
+        rank, occur = S._rank_and_occur_blocked(sids, G, block=block)
+        valid = sids >= 0          # -1 ranks are documented as unused
+        assert (np.asarray(rank)[valid]
+                == np.asarray(want_rank)[valid]).all()
+        assert (np.asarray(occur) == np.asarray(want_occur)).all()
+
 
 class TestRouteWindow:
     """The W-fused window step (one dispatch per W batches) must be

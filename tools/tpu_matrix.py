@@ -124,7 +124,42 @@ def main():
             f"({dt/ (n_calls*fuse) * 1000:.2f}ms/batch)")
     out["value"] = max(out["fuse_sweep"].values())
 
-    # ---- 3. rank block sweep is env-driven; report current ----------
+    # ---- 3. rank-block sweep (in-process: block width is a static
+    # jit arg, so one relay window covers the whole curve) -------------
+    import functools
+
+    from emqx_tpu.ops.fanout import shared_slots
+    from emqx_tpu.ops.shared import _rank_and_occur_blocked
+
+    @jax.jit
+    def mk_sids(tb, t, l, d):
+        r = shape_match(tb.shapes, t, l, d)
+        s, _ = shared_slots(tb.subs, r.matches, slot_cap=2)
+        return s
+
+    sids_staged = [mk_sids(tables, *staged[i][:3]) for i in range(8)]
+    jax.block_until_ready(sids_staged)
+    out["rank_sweep"] = {}
+    for blk in (256, 512, 1024, 2048, 4096):
+        f = jax.jit(functools.partial(
+            _rank_and_occur_blocked, n_slots=n_groups, block=blk))
+        try:
+            def run_rank(n):
+                acc = _put_retry(np.int32(0))
+                t0 = time.time()
+                for i in range(n):
+                    r, oc = f(sids_staged[i % 8])
+                    acc = acc + r.sum(dtype=jnp.int32) \
+                        + oc.sum(dtype=jnp.int32)
+                _ = int(np.asarray(acc))
+                return time.time() - t0
+            run_rank(2)
+            ms = run_rank(16) / 16 * 1000
+            out["rank_sweep"][str(blk)] = round(ms, 2)
+            log(f"rank block={blk}: {ms:.2f} ms/batch")
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            out["rank_sweep"][str(blk)] = f"{type(e).__name__}"
+            log(f"rank block={blk} failed: {e}")
     out["rank_block"] = int(os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
 
     print(json.dumps(out), flush=True)
