@@ -302,6 +302,61 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
     FAN_CAP = int(os.environ.get("BENCH_FANOUT_CAP", 4))
     SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 2))
 
+    # --- rank-block self-tune (accelerators only) ------------------------
+    # The sort-free rank kernel's block width is hardware-specific and the
+    # driver's round-end bench may be the only hardware window we get, so
+    # pick it HERE, before the main step traces (set_rank_block only
+    # affects programs traced after it). Explicit EMQX_TPU_RANK_BLOCK or
+    # BENCH_TUNE_RANK=0 skips the sweep.
+    import functools
+
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import shared as SH
+    rank_tune: dict = {}
+    tune_mode = os.environ.get("BENCH_TUNE_RANK", "1")
+    if ((jax.default_backend() != "cpu" or tune_mode == "force")
+            and "EMQX_TPU_RANK_BLOCK" not in os.environ
+            and tune_mode != "0"):
+        from emqx_tpu.ops.fanout import shared_slots
+        from emqx_tpu.ops.shapes import shape_match
+
+        @jax.jit
+        def _mk_sids(tb, t, l, d):
+            r = shape_match(tb.shapes, t, l, d)
+            s, _ = shared_slots(tb.subs, r.matches, slot_cap=SLOT_CAP)
+            return s
+
+        sids_st = [_mk_sids(tables, *staged[i][:3]) for i in range(4)]
+        jax.block_until_ready(sids_st)
+        best = None
+        for blk in (512, 1024, 2048):
+            f = jax.jit(functools.partial(
+                SH._rank_and_occur_blocked, n_slots=n_groups, block=blk))
+
+            def _run(n):
+                acc = _put_retry(np.int32(0))
+                t0 = time.time()
+                for i in range(n):
+                    r_, oc_ = f(sids_st[i % 4])
+                    acc = acc + r_.sum(dtype=jnp.int32) \
+                        + oc_.sum(dtype=jnp.int32)
+                _ = int(np.asarray(acc))
+                return time.time() - t0
+            try:
+                _run(2)
+                dt = _run(8) / 8 * 1000
+            except Exception as e:  # noqa: BLE001 — a failed width is skipped
+                log(f"rank tune block={blk} failed: {type(e).__name__}")
+                continue
+            rank_tune[str(blk)] = round(dt, 2)
+            log(f"rank tune block={blk}: {dt:.2f} ms/batch")
+            if best is None or dt < rank_tune[str(best)]:
+                best = blk
+        if best is not None:
+            SH.set_rank_block(best)
+            log(f"rank block -> {best}")
+
     def step(batch, cur):
         return route_step_shapes(tables, cur, *batch, strat,
                                  fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
@@ -453,6 +508,8 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
         "batch": B,
         "subs": subs,
         "fuse": FUSE,
+        "rank_block": SH._RANK_BLOCK,
+        **({"rank_tune_ms": rank_tune} if rank_tune else {}),
         "table_build_s": round(t_build, 1),
     }
 

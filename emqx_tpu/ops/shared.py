@@ -63,6 +63,18 @@ if _RANK_BLOCK < 8:
         f"EMQX_TPU_RANK_BLOCK must be >= 8, got {_RANK_BLOCK}")
 
 
+def set_rank_block(width: int) -> None:
+    """Set the default block width for subsequently TRACED programs
+    (bench.py self-tunes this on the target hardware before tracing its
+    main step — the optimum is hardware-specific: CPU lowers the [L, L]
+    compare to scalar loops and wants small blocks, the TPU VPU wants
+    fewer scan steps). Already-jitted programs keep their width."""
+    global _RANK_BLOCK
+    if width < 8:
+        raise ValueError(f"rank block width must be >= 8, got {width}")
+    _RANK_BLOCK = width
+
+
 def _rank_and_occur_blocked(sids: jax.Array, n_slots: int,
                             block: int | None = None):
     """Sort-free rank/occur for TPU (round-3): the round-2 argsort of the
