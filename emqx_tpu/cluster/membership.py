@@ -34,10 +34,18 @@ class Membership:
         self.max_missed = max_missed
         self.autoclean_s = autoclean_s
         self.seeds = seeds or []
-        # node -> {"addr": (host,port), "status": running|down, "last": ts}
+        # boot incarnation, gossiped with our address: lets receivers
+        # (a) ignore STALE gossip that would re-point a working peer pool
+        # back at a dead address, and (b) detect a restart even when it
+        # happened inside the failure-detection window (nodedown never
+        # fired) — the restart emits "healed" so the store resyncs the
+        # fresh incarnation's state
+        self.inc = time.time_ns()
+        # node -> {"addr": (host,port), "status": running|down,
+        #          "last": ts, "inc": peer boot incarnation or None}
         self.members: dict[str, dict] = {
             rpc.node: {"addr": rpc.address, "status": "running",
-                       "last": time.time()}}
+                       "last": time.time(), "inc": self.inc}}
         self._watchers: list[Callable[[str, str], None]] = []
         self._task: Optional[asyncio.Task] = None
         rpc.register("ekka.heartbeat", self._h_heartbeat)
@@ -128,22 +136,45 @@ class Membership:
 
     def _view(self) -> dict:
         self.members[self.rpc.node]["addr"] = self.rpc.address
-        return {n: {"addr": list(m["addr"]), "status": m["status"]}
+        return {n: {"addr": list(m["addr"]), "status": m["status"],
+                    "inc": m.get("inc")}
                 for n, m in self.members.items()}
 
     def _merge_view(self, view: dict) -> None:
         for node, m in view.items():
-            self._add_member(node, tuple(m["addr"]))
+            self._add_member(node, tuple(m["addr"]), m.get("inc"))
 
-    def _add_member(self, node: str, addr: tuple) -> None:
+    def _add_member(self, node: str, addr: tuple,
+                    inc: Optional[int] = None) -> None:
         if node == self.rpc.node:
             return
         known = self.members.get(node)
+        known_inc = known.get("inc") if known else None
+        if (inc is not None and known_inc is not None
+                and inc < known_inc):
+            # STALE gossip about a dead incarnation: acting on it would
+            # re-point a working peer pool at the corpse address
+            return
+        restarted = (inc is not None and known_inc is not None
+                     and inc > known_inc)
         self.rpc.add_peer(node, addr[0], addr[1])
-        if known is None or known["status"] != "running":
+        if known is None or known["status"] != "running" or restarted:
             self.members[node] = {"addr": addr, "status": "running",
-                                  "last": time.time()}
+                                  "last": time.time(),
+                                  "inc": inc if inc is not None
+                                  else known_inc}
+            # a restart INSIDE the failure-detection window never fires
+            # nodedown; the incarnation bump is the only restart signal,
+            # and "healed" makes the store resync (purging the dead
+            # incarnation's rows even if the fresh node stays idle)
             self._emit("healed" if known else "nodeup", node)
+        else:
+            if inc is not None:
+                known["inc"] = inc
+            if known["addr"] != addr:
+                # same incarnation at a new address cannot really happen;
+                # legacy/inc-less gossip keeps last-writer-wins behavior
+                known["addr"] = addr
 
     # ---- heartbeat / failure detection ----
     async def _beat_loop(self) -> None:

@@ -211,6 +211,7 @@ class Peer:
 
     def __init__(self, host: str, port: int, self_node: str, cookie: str,
                  n_channels: int = DEFAULT_CHANNELS):
+        self.addr = (host, port)
         self.channels = [_Channel(host, port, self_node, cookie)
                          for _ in range(n_channels)]
 
@@ -326,9 +327,23 @@ class RpcNode:
 
     # ---- outbound ----
     def add_peer(self, node: str, host: str, port: int) -> None:
-        if node not in self.peers:
-            self.peers[node] = Peer(host, port, self.node, self.cookie,
-                                    self.n_channels)
+        cur = self.peers.get(node)
+        if cur is not None:
+            if cur.addr == (host, port):
+                return
+            # the node came back at a NEW address (restart with dynamic
+            # ports): the old pool points at a corpse and every call
+            # through it would park — replace it, closing the stale
+            # channels in the background
+            del self.peers[node]
+            try:
+                asyncio.get_running_loop().create_task(cur.close())
+            except RuntimeError:          # no loop (sync test context)
+                for ch in cur.channels:
+                    if ch.writer is not None:
+                        ch.writer.close()
+        self.peers[node] = Peer(host, port, self.node, self.cookie,
+                                self.n_channels)
 
     async def drop_peer(self, node: str) -> None:
         peer = self.peers.pop(node, None)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Callable, Optional
 
 from emqx_tpu.cluster.membership import Membership
@@ -85,6 +86,16 @@ class ClusterStore:
         self.membership = membership
         self.tables: dict[str, Table] = {}
         self._seq = 0                         # ops this origin has published
+        # boot incarnation: a restarted origin restarts its seq at 0, and
+        # a replica that kept the old origin's _applied would swallow every
+        # new op as a "duplicate" (observed: a node rejoining before
+        # nodedown fired was unreachable — its route ops were dropped).
+        # Ops carry (incarnation, seq); a NEWER incarnation purges the
+        # origin's old rows and resets its seq tracking (the analog of
+        # mnesia recopying a restarted node's table). Wall-clock ns: restart
+        # gaps are seconds, far above any cross-host skew that matters.
+        self._inc = time.time_ns()
+        self._origin_inc: dict[str, int] = {}  # origin -> its incarnation
         self._applied: dict[str, int] = {}    # origin -> last applied seq
         self._buffer: dict[str, dict[int, tuple]] = {}  # out-of-order holds
         self._lag_seen: dict[str, int] = {}   # origin -> applied at last check
@@ -144,13 +155,27 @@ class ClusterStore:
         for node in self.membership.other_nodes():
             # key-pinned so one origin's ops for one route key stay ordered
             await self.rpc.cast(node, "store.op",
-                                [me, self._seq, op, table, key, value],
+                                [me, self._inc, self._seq, op, table, key,
+                                 value],
                                 key=f"{table}:{key}")
 
-    async def _h_op(self, origin: str, seq: int, op: str, table: str,
-                    key: Any, value: Any) -> None:
+    async def _h_op(self, origin: str, inc: int, seq: int, op: str,
+                    table: str, key: Any, value: Any) -> None:
         if isinstance(key, list):        # tuple keys round-trip as JSON lists
             key = tuple(key)
+        known_inc = self._origin_inc.get(origin)
+        if known_inc is None or inc > known_inc:
+            # first contact, or the origin RESTARTED: its old rows are a
+            # dead incarnation's state and its seq restarted at 0 — purge
+            # and track the new incarnation, or every fresh op would be
+            # dropped as a duplicate of the old sequence
+            if known_inc is not None:
+                self.purge_origin(origin)
+            self._origin_inc[origin] = inc
+            self._applied[origin] = 0
+            self._buffer.pop(origin, None)
+        elif inc < known_inc:
+            return          # straggler from a dead incarnation: drop
         last = self._applied.get(origin, 0)
         if seq <= last:
             return                          # duplicate
@@ -167,7 +192,7 @@ class ClusterStore:
     # ---- snapshot sync (mnesia copy_table analog) ----
     def _snapshot(self) -> dict:
         me = self.rpc.node
-        out: dict = {"seq": self._seq, "tables": {}}
+        out: dict = {"seq": self._seq, "inc": self._inc, "tables": {}}
         for name, tab in self.tables.items():
             rows = []
             for key, per in tab.rows.items():
@@ -190,6 +215,8 @@ class ClusterStore:
                     key = tuple(key)
                 tab._apply("add", key, v, node)
         self._applied[node] = snap["seq"]
+        if "inc" in snap:     # a live node's snapshot is authoritative
+            self._origin_inc[node] = snap["inc"]
         self._buffer.pop(node, None)
 
     # ---- failure cleanup (emqx_router_helper:cleanup_routes, §3.5) ----

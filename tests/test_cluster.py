@@ -565,6 +565,139 @@ async def _test_device_shared_local_groups():
         await teardown(clusters)
 
 
+def test_rejoin_new_address_reachable(loop):
+    run(loop, _test_rejoin_new_address())
+
+
+async def _test_rejoin_new_address():
+    """A member that dies and rejoins at a NEW address (dynamic ports)
+    must be reachable again: add_peer used to keep the stale channel
+    pool, so survivors kept dialing the corpse and cross-node delivery
+    to the rejoined node silently died."""
+    from emqx_tpu.broker.message import make
+    from emqx_tpu.broker.node import Node
+    from emqx_tpu.cluster import ClusterNode
+
+    nodes, clusters = await make_cluster(2)
+    try:
+        await clusters[1].stop()          # abrupt death (no leave)
+        await asyncio.sleep(0.3)
+        node1b = Node(use_device=False, name="n1@127.0.0.1")
+        cn1b = ClusterNode(node1b, port=0, heartbeat_s=0.05)
+        await cn1b.start()
+        assert cn1b.address != clusters[1].address   # genuinely new port
+        await cn1b.join(*clusters[0].address)
+        clusters.append(cn1b)
+        nodes.append(node1b)
+        await settle(clusters, 0.3)
+
+        cap = Capture()
+        node1b.broker.subscribe(node1b.broker.register(cap, "c1"),
+                                "rejoin/t")
+        await settle(clusters, 0.3)
+        await nodes[0].broker.publish_async(
+            make("pub", 0, "rejoin/t", b"hi"))
+        for _ in range(20):
+            if cap.msgs:
+                break
+            await asyncio.sleep(0.05)
+        assert cap.msgs, "seed still dials the dead address (stale peer)"
+    finally:
+        await teardown(clusters)
+
+
+def test_fast_rejoin_before_nodedown(loop):
+    run(loop, _test_fast_rejoin_before_nodedown())
+
+
+async def _test_fast_rejoin_before_nodedown():
+    """A node that restarts and rejoins BEFORE failure detection fires:
+    the survivor never saw nodedown, so no heal-sync runs — only the op
+    incarnation tells it the origin's sequence restarted. Without it,
+    the fresh node's ops were dropped as duplicates of the dead
+    incarnation's sequence and its routes never replicated."""
+    from emqx_tpu.broker.message import make
+    from emqx_tpu.broker.node import Node
+    from emqx_tpu.cluster import ClusterNode
+
+    # slow heartbeat: nodedown CANNOT fire within this test
+    nodes, clusters = await make_cluster(2, )
+    for cn in clusters:
+        cn.membership.heartbeat_s = 5.0
+    try:
+        # seed has applied ops from n1 (its boot-time registrations)
+        await settle(clusters, 0.1)
+        applied_before = dict(clusters[0].store._applied)
+        await clusters[1].stop()          # abrupt; no nodedown yet
+        node1b = Node(use_device=False, name="n1@127.0.0.1")
+        cn1b = ClusterNode(node1b, port=0, heartbeat_s=5.0)
+        await cn1b.start()
+        await cn1b.join(*clusters[0].address)
+        clusters.append(cn1b)
+        nodes.append(node1b)
+        await settle(clusters, 0.1)
+        assert clusters[0].membership.is_running("n1@127.0.0.1")
+
+        cap = Capture()
+        node1b.broker.subscribe(node1b.broker.register(cap, "c1"),
+                                "fastrejoin/t")
+        await settle(clusters, 0.2)
+        # the route op (fresh incarnation, seq ~1) must be APPLIED at the
+        # seed even though applied[n1] was left at the old sequence
+        assert "fastrejoin/t" in nodes[0].broker.router.topics(), \
+            f"fresh ops swallowed (applied_before={applied_before})"
+        await nodes[0].broker.publish_async(
+            make("pub", 0, "fastrejoin/t", b"hi"))
+        for _ in range(20):
+            if cap.msgs:
+                break
+            await asyncio.sleep(0.05)
+        assert cap.msgs, "delivery to fast-rejoined node failed"
+    finally:
+        await teardown(clusters)
+
+
+def test_fast_rejoin_purges_ghost_routes(loop):
+    run(loop, _test_fast_rejoin_purges_ghost_routes())
+
+
+async def _test_fast_rejoin_purges_ghost_routes():
+    """An IDLE node that fast-rejoins (nodedown never fired, no new ops)
+    must still shed its dead incarnation's rows on survivors: the
+    membership incarnation bump emits healed -> store resync. Without it,
+    publishes kept being forwarded to ghost subscribers forever."""
+    from emqx_tpu.broker.node import Node
+    from emqx_tpu.cluster import ClusterNode
+
+    nodes, clusters = await make_cluster(2)
+    for cn in clusters:
+        cn.membership.heartbeat_s = 5.0   # nodedown cannot fire
+    try:
+        cap = Capture()
+        nodes[1].broker.subscribe(nodes[1].broker.register(cap, "g"),
+                                  "ghost/t")
+        await settle(clusters, 0.2)
+        assert "ghost/t" in nodes[0].broker.router.topics()
+
+        await clusters[1].stop()          # abrupt; seed still thinks up
+        node1b = Node(use_device=False, name="n1@127.0.0.1")
+        cn1b = ClusterNode(node1b, port=0, heartbeat_s=5.0)
+        await cn1b.start()
+        await cn1b.join(*clusters[0].address)   # rejoins IDLE
+        clusters.append(cn1b)
+        nodes.append(node1b)
+        # healed fires on the incarnation bump -> seed resyncs n1's
+        # (empty) snapshot, purging the ghost route
+        for _ in range(40):
+            if "ghost/t" not in nodes[0].broker.router.topics():
+                break
+            await asyncio.sleep(0.05)
+        assert "ghost/t" not in nodes[0].broker.router.topics(), \
+            "dead incarnation's route survived an idle fast-rejoin"
+    finally:
+        await teardown(clusters)
+
+
 def test_rpc_half_open_channel_fails_fast(loop):
     run(loop, _test_rpc_half_open())
 

@@ -77,6 +77,23 @@ def two_nodes():
                 p.kill()
 
 
+def test_chaos_cycles():
+    """Bounded chaos run (tools/chaos_cluster.py): 3-node OS-process
+    cluster, SIGKILL a random node per cycle under QoS1 traffic, assert
+    fast CONNECT on survivors, PUBACK continuity, delivery resumption,
+    membership re-convergence, and reachability of the rejoined node at
+    its new dynamic ports. The long-form drive is the same tool with
+    more cycles."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_cluster.py"),
+         "2"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, f"chaos failed:\n{r.stdout}\n{r.stderr}"
+    assert "CHAOS OK" in r.stdout
+
+
 def test_cross_process_pubsub(two_nodes):
     (pa, mqtt_a, _), (pb, mqtt_b, _) = two_nodes
 

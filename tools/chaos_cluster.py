@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Cluster chaos drive: random node kills under continuous QoS1 traffic.
+
+The reference's failure story is tested with docker-compose node kills
+(scripts/ + emqx_takeover_SUITE.erl); this is the sharper analog: a
+3-OS-process cluster where a random non-seed node is SIGKILLed mid-flood,
+its clients re-home to a survivor (cross-node takeover of the same
+clientid), the victim is restarted and rejoined, and four invariants are
+asserted every cycle:
+
+  1. CONNECT to any survivor completes fast (<2s) — a dead peer must
+     never park the clientid lock (the half-open RPC channel regression).
+  2. QoS1 publishes keep earning PUBACKs throughout the outage.
+  3. The anchor subscriber (on the seed) resumes receiving within the
+     bound after each kill — routes survive peer death.
+  4. After the victim rejoins, membership converges back to 3 running
+     nodes (anti-entropy + autoheal).
+
+Usage: python tools/chaos_cluster.py [cycles]    (default 6)
+
+Exit 0 with "CHAOS OK" on success; assertion failure otherwise.
+"""
+
+import asyncio
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def spawn(name, join=None):
+    from test_two_process_cluster import _readline_deadline
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_node.py"),
+           "--name", name, "--no-device"]
+    if join:
+        cmd += ["--join", join]
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, env=env)
+    line = _readline_deadline(p, 60).strip()
+    assert line.startswith("READY "), f"{name}: {line}"
+    _, mqtt, rpc = line.split()
+    return {"p": p, "mqtt": int(mqtt), "rpc": int(rpc), "name": name}
+
+
+async def connect_fast(port, clientid, bound_s=2.0):
+    """Invariant 1: CONNECT to a live node must complete inside bound_s
+    even right after a peer died (pre-nodedown-detection window)."""
+    from emqx_tpu.client import Client
+    c = Client(port=port, clientid=clientid)
+    t0 = time.monotonic()
+    await c.connect(timeout=bound_s + 3)
+    dt = time.monotonic() - t0
+    assert dt < bound_s, f"CONNECT took {dt:.1f}s (> {bound_s}s) on :{port}"
+    return c
+
+
+async def main(cycles: int) -> None:
+    from emqx_tpu.mqtt import packet as P
+
+    seed = spawn("a@127.0.0.1")
+    b = spawn("b@127.0.0.1", join=f"127.0.0.1:{seed['rpc']}")
+    c = spawn("c@127.0.0.1", join=f"127.0.0.1:{seed['rpc']}")
+    others = {"b@127.0.0.1": b, "c@127.0.0.1": c}
+    procs = [seed, b, c]
+    rng = random.Random(int(os.environ.get("CHAOS_SEED", 42)))
+
+    anchor = await connect_fast(seed["mqtt"], "anchor")
+    await anchor.subscribe([("chaos/#", P.SubOpts(qos=1))])
+
+    seq = 0
+    received: set = set()
+
+    async def drain_anchor():
+        while not anchor.messages.empty():
+            m = anchor.messages.get_nowait()
+            received.add(int(m.payload))
+
+    async def publish_burst(cl, n, bound_s=3.0):
+        """Invariant 2: every QoS1 publish earns its PUBACK in bound."""
+        nonlocal seq
+        for _ in range(n):
+            t0 = time.monotonic()
+            await cl.publish("chaos/t", str(seq).encode(), qos=1,
+                             timeout=bound_s + 2)
+            dt = time.monotonic() - t0
+            assert dt < bound_s, f"PUBACK took {dt:.1f}s"
+            seq += 1
+            await asyncio.sleep(0)
+
+    async def wait_resume(deadline_s=8.0):
+        """Invariant 3: the anchor sees NEW messages within the bound."""
+        start_seq = seq
+        pub2 = await connect_fast(seed["mqtt"], "probe-pub")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            await publish_burst(pub2, 1)
+            await asyncio.sleep(0.1)
+            await drain_anchor()
+            if any(s >= start_seq for s in received):
+                await pub2.disconnect()
+                return
+        raise AssertionError(f"anchor got nothing new in {deadline_s}s")
+
+    async def wait_members(n, deadline_s=15.0):
+        """Invariant 4: membership converges to n running nodes."""
+        from emqx_tpu.cluster.rpc import RpcNode
+        probe = RpcNode("probe@x", port=0)
+        await probe.start()
+        try:
+            probe.add_peer("seed", "127.0.0.1", seed["rpc"])
+            t0 = time.monotonic()
+            last = None
+            while time.monotonic() - t0 < deadline_s:
+                try:
+                    info = await probe.call("seed", "ekka.heartbeat",
+                                            ["probe@x", None], timeout=2)
+                    last = sorted(k for k, v in info.items()
+                                  if v["status"] == "running"
+                                  and not k.startswith("probe"))
+                    if len(last) == n:
+                        return
+                except Exception:  # noqa: BLE001 — retry until deadline
+                    pass
+                await asyncio.sleep(0.3)
+            raise AssertionError(f"membership stuck at {last}, want {n}")
+        finally:
+            await probe.stop()
+
+    # steady state: publisher on b, extra subscriber on c
+    pub = await connect_fast(b["mqtt"], "chaos-pub")
+    extra = await connect_fast(c["mqtt"], "extra-sub")
+    await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
+    await publish_burst(pub, 20)
+    await wait_resume()
+
+    for cycle in range(cycles):
+        victim_name = rng.choice(list(others))
+        victim = others[victim_name]
+        print(f"[cycle {cycle}] kill -9 {victim_name}", flush=True)
+        victim["p"].kill()
+        victim["p"].wait(10)
+
+        # clients that lived on the victim re-home to the seed with the
+        # SAME clientid — exercises cross-node takeover while the old
+        # owner is an undetected corpse
+        if pub.port == victim["mqtt"]:
+            pub = await connect_fast(seed["mqtt"], "chaos-pub")
+        if extra.port == victim["mqtt"]:
+            extra = await connect_fast(seed["mqtt"], "extra-sub")
+            await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
+
+        await publish_burst(pub, 10)          # invariant 2 during outage
+        await wait_resume()                   # invariant 3
+
+        # heal: restart victim, rejoin
+        fresh = spawn(victim_name, join=f"127.0.0.1:{seed['rpc']}")
+        others[victim_name] = fresh
+        procs.append(fresh)
+        await wait_members(3)                 # invariant 4
+        await publish_burst(pub, 10)
+        await wait_resume()
+
+        # invariant 5: the REJOINED node (new dynamic ports) must be
+        # deliverable-to from survivors — the stale-peer regression
+        # (add_peer keeping the old channel pool) made exactly this path
+        # silently dead while everything else stayed green
+        back = await connect_fast(fresh["mqtt"], f"back-{cycle}")
+        await back.subscribe([(f"back/{cycle}", P.SubOpts(qos=1))])
+        t0 = time.monotonic()
+        got_back = False
+        while time.monotonic() - t0 < 8.0 and not got_back:
+            await pub.publish(f"back/{cycle}", b"x", qos=1, timeout=5)
+            try:
+                await asyncio.wait_for(back.messages.get(), 0.3)
+                got_back = True
+            except asyncio.TimeoutError:
+                pass
+        assert got_back, f"rejoined {victim_name} unreachable (stale peer)"
+        await back.disconnect()
+        print(f"[cycle {cycle}] healed, seq={seq}, "
+              f"anchor_received={len(received)}", flush=True)
+
+    await drain_anchor()
+    # the anchor lives on the never-killed seed: everything published
+    # while it was subscribed must have arrived (QoS1, local or relayed
+    # from a LIVE publisher node — kills happen between bursts)
+    missing = [s for s in range(seq) if s not in received]
+    assert not missing, f"anchor lost {len(missing)} messages: " \
+                        f"{missing[:10]}..."
+    print(f"CHAOS OK: {cycles} cycles, {seq} published, "
+          f"{len(received)} received, 0 lost", flush=True)
+
+    for cl in (anchor, pub, extra):
+        try:
+            await cl.disconnect()
+        except Exception:  # noqa: BLE001
+            pass
+    for pr in procs:
+        if pr["p"].poll() is None:
+            pr["p"].send_signal(signal.SIGTERM)
+    for pr in procs:
+        try:
+            pr["p"].wait(10)
+        except subprocess.TimeoutExpired:
+            pr["p"].kill()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 6))
