@@ -255,3 +255,102 @@ class TestHoconProps:
         from emqx_tpu.utils import hocon
         text = hocon.dumps(conf)
         assert hocon.loads(text) == conf
+
+
+class TestStoreReplicationProps:
+    """Replica convergence of the single-writer op log (cluster/store.py)
+    under adversarial delivery: arbitrary reordering, duplication, and
+    stragglers from dead incarnations. The invariant everything else
+    (routes, shared groups, banned) rests on: once every op of the
+    LATEST incarnation is delivered — in any order, interleaved with any
+    garbage from older incarnations — the replica's view of that origin
+    equals the origin's own sequential state."""
+
+    @staticmethod
+    def _mk_store():
+        import asyncio
+
+        from emqx_tpu.cluster.store import ClusterStore
+
+        class _Rpc:
+            node = "replica@x"
+
+            def register(self, *_a):
+                pass
+
+        class _Membership:
+            def monitor(self, *_a):
+                pass
+
+            def other_nodes(self):
+                return []
+
+        return ClusterStore(_Rpc(), _Membership()), asyncio
+
+    @staticmethod
+    def _model_apply(ops):
+        """Sequentially apply [(op, key, value)] the way a bag table
+        does: add dedups, del removes one instance."""
+        state: dict = {}
+        for op, key, value in ops:
+            vals = state.setdefault(key, [])
+            if op == "add":
+                if value not in vals:
+                    vals.append(value)
+            elif value in vals:
+                vals.remove(value)
+            if not vals:
+                state.pop(key, None)
+        return state
+
+    @settings(max_examples=60, deadline=None,
+              suppress_health_check=[HealthCheck.too_slow])
+    @given(
+        ops1=st.lists(st.tuples(st.sampled_from(["add", "del"]),
+                                st.sampled_from(["k1", "k2", "k3"]),
+                                st.integers(0, 4)), max_size=12),
+        ops2=st.lists(st.tuples(st.sampled_from(["add", "del"]),
+                                st.sampled_from(["k1", "k2", "k3"]),
+                                st.integers(0, 4)), max_size=12),
+        seed=st.integers(0, 2**32 - 1),
+        dup_frac=st.floats(0, 1),
+    )
+    def test_converges_despite_reorder_dup_stragglers(
+            self, ops1, ops2, seed, dup_frac):
+        import random
+
+        store, asyncio = self._mk_store()
+        origin, inc1, inc2 = "n1@x", 1000, 2000
+
+        def frames(inc, ops):
+            return [(origin, inc, i + 1, op, "t", k, v)
+                    for i, (op, k, v) in enumerate(ops)]
+
+        # deliver inc1 fully (any prefix state is fine — it gets purged),
+        # then a shuffled mix of: ALL inc2 frames, duplicated inc2
+        # frames, and straggler inc1 frames
+        rng = random.Random(seed)
+        mix = frames(inc2, ops2)[:]
+        mix += [f for f in frames(inc2, ops2) if rng.random() < dup_frac]
+        mix += [f for f in frames(inc1, ops1) if rng.random() < 0.5]
+        rng.shuffle(mix)
+
+        async def drive():
+            for f in frames(inc1, ops1):
+                await store._h_op(*f)
+            for f in mix:
+                await store._h_op(*f)
+
+        asyncio.run(drive())
+        want = self._model_apply(ops2) if ops2 else (
+            # no inc2 ops ever sent: the replica legitimately still holds
+            # inc1's state (a restart is only observable via its ops)
+            self._model_apply(ops1))
+        got = {k: per[origin]
+               for k, per in store.table("t").rows.items()
+               if origin in per}
+        assert {k: sorted(v) for k, v in got.items()} \
+            == {k: sorted(v) for k, v in want.items()}
+        if ops1 or ops2:
+            assert store._applied[origin] == (len(ops2) if ops2
+                                              else len(ops1))
