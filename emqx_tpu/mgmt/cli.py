@@ -74,8 +74,53 @@ class Cli:
         r("rules", self._rules, "rules list | show <id> | delete <id>")
         r("mgmt", self._mgmt,
           "mgmt list | insert <app_id> <name> | delete <app_id>")
+        r("trace", self._trace,
+          "trace start client|topic <value> <file> | "
+          "trace stop client|topic <value> | trace list | "
+          "trace device start <dir> | trace device stop")
 
     # ---- commands ----
+    async def _trace(self, args) -> str:
+        """emqx_ctl trace analog, plus the device-side jax.profiler trace
+        (SURVEY §5.1): `trace device start <dir>` annotates every route
+        dispatch as a profiler step so device execution decomposes from
+        host/relay time in the captured trace."""
+        if not args:
+            raise _Usage()
+        if args[0] == "device":
+            eng = getattr(self.node, "device_engine", None)
+            if eng is None:
+                return "device routing is not enabled on this node"
+            if args[1:2] == ["start"] and len(args) == 3:
+                ok = eng.start_device_trace(args[2])
+                return ("device trace started" if ok
+                        else "backend has no profiler support")
+            if args[1:2] == ["stop"]:
+                eng.stop_device_trace()
+                return "device trace stopped"
+            raise _Usage()
+        from emqx_tpu.apps.tracer import Tracer
+        tr = self.node.get_app(Tracer)
+        if tr is None:
+            tr = self.node.register_app(Tracer(self.node).load())
+        if args[0] == "list":
+            rows = tr.lookup_traces()
+            if not rows:
+                return "no traces"
+            return "\n".join(f"{r['type']:<9} {r['value']:<24} {r['path']}"
+                             for r in rows)
+        if args[0] == "start" and len(args) == 4 \
+                and args[1] in ("client", "topic"):
+            kind = "clientid" if args[1] == "client" else "topic"
+            return ("trace started" if tr.start_trace(kind, args[2], args[3])
+                    else "already tracing that")
+        if args[0] == "stop" and len(args) == 3 \
+                and args[1] in ("client", "topic"):
+            kind = "clientid" if args[1] == "client" else "topic"
+            return ("trace stopped" if tr.stop_trace(kind, args[2])
+                    else "no such trace")
+        raise _Usage()
+
     async def _status(self, _args) -> str:
         info = (await self.mgmt.list_brokers())[0]
         return (f"Node {self.node.name} is started\n"

@@ -397,3 +397,45 @@ class TestApiHardening:
         assert "subscriptions list" in out
         out = run(loop, cli.run(["banned", "add", "clientid", "x", "zz"]))
         assert "banned list" in out
+
+    def test_cli_trace(self, loop, stack, tmp_path):
+        """emqx_ctl trace analog: client/topic traces capture events to a
+        file; `trace device` drives the route engine's jax.profiler
+        hooks (no device engine on this stack -> explicit message)."""
+        node, lst, api, cli = stack
+        f = tmp_path / "t.log"
+        out = run(loop, cli.run(["trace", "start", "client", "tr-c1",
+                                 str(f)]))
+        assert out == "trace started"
+        out = run(loop, cli.run(["trace", "list"]))
+        assert "tr-c1" in out
+
+        async def go():
+            c = Client(port=lst.port, clientid="tr-c1")
+            await c.connect()
+            await c.publish("tr/t", b"x", qos=0)
+            await c.disconnect()
+        run(loop, go())
+        out = run(loop, cli.run(["trace", "stop", "client", "tr-c1"]))
+        assert out == "trace stopped"
+        text = f.read_text()
+        assert "CONNECTED" in text and "tr-c1" in text
+        out = run(loop, cli.run(["trace", "device", "start", "/tmp/x"]))
+        assert "not enabled" in out     # stack boots use_device=False
+
+    def test_cli_device_trace_with_engine(self, loop, tmp_path):
+        """With a device engine, `trace device start/stop` captures a
+        jax.profiler trace around live dispatches (CPU backend traces
+        fine — the same code path the TPU uses)."""
+        node = Node(use_device=True)
+        cli = Cli(node)
+        out = run(loop, cli.run(["trace", "device", "start",
+                                 str(tmp_path)]))
+        assert out in ("device trace started",
+                       "backend has no profiler support")
+        out2 = run(loop, cli.run(["trace", "device", "stop"]))
+        assert out2 == "device trace stopped"
+        if out == "device trace started":
+            import os
+            assert any(True for _r, _d, fs in os.walk(tmp_path)
+                       for _f in fs), "profiler wrote nothing"
