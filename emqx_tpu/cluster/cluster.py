@@ -464,6 +464,12 @@ class ClusterNode:
     # divergent views still serialize on the first common node, and
     # sorted-order acquisition cannot deadlock.
     LOCK_LEASE_S = 15.0
+    LOCK_DEADLINE_S = 30.0      # total acquire budget across all targets
+    LOCK_RPC_TIMEOUT_S = 3.0    # per-call bound: a FROZEN target (gray
+    # failure: TCP open, node unresponsive) must cost callers a short
+    # timeout + retry until failure detection drops it from the target
+    # list — not a 35s CONNECT stall (the old handler parked contended
+    # acquires server-side for 30s, so calls needed a 35s timeout)
 
     def _lock_targets(self) -> list[str]:
         nodes = self.membership.running_nodes()   # sorted
@@ -472,7 +478,14 @@ class ClusterNode:
     async def _h_lock_acquire(self, clientid: str, token: str,
                               lease_s: float) -> bool:
         import time
-        deadline = time.monotonic() + 30
+        cur = self._lock_tab.get(clientid)
+        if cur is not None and cur[0] == token:
+            # retry after a lost reply (or lease refresh): idempotent
+            self._lock_tab[clientid] = (token, time.monotonic() + lease_s)
+            return True
+        # short grace for a release already in flight; sustained
+        # contention reports False FAST — the caller owns retry policy
+        deadline = time.monotonic() + 0.5
         while time.monotonic() < deadline:
             cur = self._lock_tab.get(clientid)
             if cur is None or cur[1] < time.monotonic():
@@ -495,28 +508,57 @@ class ClusterNode:
 
         class _Guard:
             async def __aenter__(self):
+                import time
                 import uuid
                 self.token = uuid.uuid4().hex
                 self.held: list[str] = []
+                deadline = time.monotonic() + cluster.LOCK_DEADLINE_S
                 ok_any = False
                 for target in cluster._lock_targets():
-                    try:
-                        ok = await cluster.rpc.call(
-                            target, "locker.acquire",
-                            [clientid, self.token,
-                             cluster.LOCK_LEASE_S],
-                            key=clientid, timeout=35)
-                    except RpcError:
-                        continue   # unreachable node: lease logic covers us
-                    if ok:
-                        self.held.append(target)
-                        ok_any = True
-                    else:
-                        # a REACHABLE target refused (still held elsewhere):
-                        # proceeding would break mutual exclusion — back out
-                        await self._release_held()
-                        raise RpcError(
-                            f"lock {clientid}: contended on {target}")
+                    while True:
+                        try:
+                            ok = await cluster.rpc.call(
+                                target, "locker.acquire",
+                                [clientid, self.token,
+                                 cluster.LOCK_LEASE_S],
+                                key=clientid,
+                                timeout=cluster.LOCK_RPC_TIMEOUT_S)
+                        except RpcError:
+                            # unreachable (refused) or unresponsive
+                            # (frozen — the bounded call/handshake turns
+                            # gray failure into this same fast error):
+                            # skip the target. Mutual exclusion holds on
+                            # the common RESPONSIVE prefix — both
+                            # contenders still serialize on it — and the
+                            # lease covers the rest. The target may have
+                            # processed the acquire with the reply lost
+                            # (a ~3s stall, not a death): fire a
+                            # best-effort release in the BACKGROUND so an
+                            # orphaned lease doesn't block this
+                            # clientid's next acquire for the full lease
+                            # window — awaiting it here would park this
+                            # acquire on the frozen target's connect
+                            # timeout, the exact stall being avoided
+                            t = asyncio.get_running_loop().create_task(
+                                cluster.rpc.cast(
+                                    target, "locker.release",
+                                    [clientid, self.token],
+                                    key=clientid))
+                            cluster._fwd_tasks.add(t)
+                            t.add_done_callback(cluster._fwd_tasks.discard)
+                            break
+                        if ok:
+                            self.held.append(target)
+                            ok_any = True
+                            break
+                        # REACHABLE but contended: wait for the holder's
+                        # release (or lease expiry) — never skip it, or
+                        # mutual exclusion breaks
+                        if time.monotonic() > deadline:
+                            await self._release_held()
+                            raise RpcError(
+                                f"lock {clientid}: contended on {target}")
+                        await asyncio.sleep(0.05)
                 if not ok_any:
                     raise RpcError(f"lock {clientid}: no target reachable")
                 return self

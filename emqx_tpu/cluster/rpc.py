@@ -29,6 +29,10 @@ log = logging.getLogger("emqx_tpu.cluster.rpc")
 
 DEFAULT_CHANNELS = 4          # gen_rpc tcp_client_num default is 1; we pin 4
 CALL_TIMEOUT = 10.0
+CONNECT_TIMEOUT = 5.0         # TCP connect + hello handshake bound: a
+# FROZEN peer (SIGSTOP — gray failure) accepts TCP and then never answers
+# the hello; an unbounded handshake parked the heartbeat loop, so
+# failure detection never fired and every caller waited its full budget
 
 
 class RpcError(Exception):
@@ -104,19 +108,27 @@ class _Channel:
         # this, a racing call whose send beat the reconnect would park
         # for its full timeout when this connect() fails.)
         self._fail_pending(RpcError("connection closed"))
-        self.reader, self.writer = await asyncio.open_connection(
-            self.host, self.port)
-        self.writer.write(encode_frame(
-            {"t": "hello", "node": self.node, "cookie": self.cookie}))
-        await self.writer.drain()
-        ack = await read_frame(self.reader)
-        if not ack or ack.get("t") != "hello_ok":
-            # close the fresh writer or the channel is left half-open
-            # (alive with no reader) and the NEXT call parks for its
-            # full timeout instead of re-failing fast
-            self.writer.close()
-            raise RpcError(f"handshake rejected by {self.host}:{self.port}")
-        self._reader_task = asyncio.create_task(self._read_loop())
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT):
+                self.reader, self.writer = await asyncio.open_connection(
+                    self.host, self.port)
+                self.writer.write(encode_frame(
+                    {"t": "hello", "node": self.node,
+                     "cookie": self.cookie}))
+                await self.writer.drain()
+                ack = await read_frame(self.reader)
+            if not ack or ack.get("t") != "hello_ok":
+                raise RpcError(
+                    f"handshake rejected by {self.host}:{self.port}")
+            self._reader_task = asyncio.create_task(self._read_loop())
+        except BaseException:
+            # timeout, reject, OR cancellation mid-handshake: never leave
+            # the channel half-open (writer alive, no reader) — the NEXT
+            # call would park for its full budget instead of failing fast
+            if self.writer is not None:
+                self.writer.close()
+                self.writer = None
+            raise
 
     async def _read_loop(self) -> None:
         # EVERY exit path — clean EOF (FIN), connection reset (RST: a
@@ -175,7 +187,8 @@ class _Channel:
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         data = encode_frame({"t": "call", "id": rid, "fn": fn, "args": args})
-        try:
+
+        async def _go():
             # register the future only once the connection is up, under
             # the send lock: connect() fails every pending future (they
             # belong to the dead connection), so registering earlier
@@ -186,7 +199,13 @@ class _Channel:
                 self._pending[rid] = fut
                 self.writer.write(data)
                 await self.writer.drain()
-            reply = await asyncio.wait_for(fut, timeout)
+            return await fut
+
+        try:
+            # the timeout covers the WHOLE call — including the connect/
+            # handshake phase, which parks indefinitely against a frozen
+            # peer (connect() cleans up its own state on cancellation)
+            reply = await asyncio.wait_for(_go(), timeout)
         except (asyncio.TimeoutError, ConnectionError, OSError) as e:
             self._pending.pop(rid, None)
             raise RpcError(f"call {fn} failed: {e}") from e

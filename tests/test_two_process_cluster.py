@@ -84,7 +84,7 @@ def test_chaos_cycles():
     membership re-convergence, and reachability of the rejoined node at
     its new dynamic ports. The long-form drive is the same tool with
     more cycles."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CHAOS_LAX="3")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_cluster.py"),
@@ -139,6 +139,50 @@ def test_cross_process_pubsub(two_nodes):
 
         for c in (sub, pub, sub2, pub2):
             await c.disconnect()
+
+    asyncio.run(go())
+
+
+def test_gray_failure_frozen_peer(two_nodes):
+    """SIGSTOP (gray failure: TCP open, node unresponsive) must not park
+    CONNECT on the survivor: the clientid-lock RPC and the heartbeat
+    probe both bound their connect/handshake phase, so failure detection
+    proceeds and the lock skips the frozen target within ~detection +
+    one RPC timeout. Pre-fix this parked 25s+ (unbounded handshake wedged
+    the beat loop, so nodedown never fired)."""
+    import time
+
+    (pa, mqtt_a, _), (pb, _mqtt_b, _) = two_nodes
+
+    async def go():
+        from emqx_tpu.client import Client
+        from emqx_tpu.mqtt import packet as P
+
+        warm = Client(port=mqtt_a, clientid="warm")
+        await warm.connect()
+        await warm.disconnect()
+
+        os.kill(pb.pid, signal.SIGSTOP)
+        try:
+            await asyncio.sleep(0.3)
+            t0 = time.monotonic()
+            c = Client(port=mqtt_a, clientid="during-freeze")
+            await c.connect(timeout=20)
+            dt = time.monotonic() - t0
+            assert dt < 15, f"gray failure parked CONNECT {dt:.1f}s"
+            # the survivor still serves end-to-end during the freeze
+            await c.subscribe([("gray/t", P.SubOpts(qos=1))])
+            await c.publish("gray/t", b"ping", qos=1)
+            got = await asyncio.wait_for(c.messages.get(), 10)
+            assert got.payload == b"ping"
+            await c.disconnect()
+        finally:
+            os.kill(pb.pid, signal.SIGCONT)
+
+        await asyncio.sleep(2)            # thaw: autoheal
+        c2 = Client(port=mqtt_a, clientid="after-thaw")
+        await c2.connect(timeout=10)
+        await c2.disconnect()
 
     asyncio.run(go())
 

@@ -33,6 +33,12 @@ REPO = os.path.join(os.path.dirname(__file__), "..")
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
+# latency-bound scale: the bounds separate "healthy" (<2s) from the
+# 35s-stall bug class; under heavy CPU contention (full pytest suite +
+# 5 broker processes on a small box) honest 2s bounds flake, so the
+# in-suite wrapper runs with CHAOS_LAX=3
+LAX = float(os.environ.get("CHAOS_LAX", "1"))
+
 
 def spawn(name, join=None):
     from test_two_process_cluster import _readline_deadline
@@ -50,9 +56,10 @@ def spawn(name, join=None):
     return {"p": p, "mqtt": int(mqtt), "rpc": int(rpc), "name": name}
 
 
-async def connect_fast(port, clientid, bound_s=2.0):
+async def connect_fast(port, clientid, bound_s=None):
     """Invariant 1: CONNECT to a live node must complete inside bound_s
     even right after a peer died (pre-nodedown-detection window)."""
+    bound_s = (bound_s or 2.0) * LAX
     from emqx_tpu.client import Client
     c = Client(port=port, clientid=clientid)
     t0 = time.monotonic()
@@ -83,8 +90,9 @@ async def main(cycles: int) -> None:
             m = anchor.messages.get_nowait()
             received.add(int(m.payload))
 
-    async def publish_burst(cl, n, bound_s=3.0):
+    async def publish_burst(cl, n, bound_s=None):
         """Invariant 2: every QoS1 publish earns its PUBACK in bound."""
+        bound_s = (bound_s or 3.0) * LAX
         nonlocal seq
         for _ in range(n):
             t0 = time.monotonic()
@@ -95,8 +103,9 @@ async def main(cycles: int) -> None:
             seq += 1
             await asyncio.sleep(0)
 
-    async def wait_resume(deadline_s=8.0):
+    async def wait_resume(deadline_s=None):
         """Invariant 3: the anchor sees NEW messages within the bound."""
+        deadline_s = (deadline_s or 8.0) * LAX
         start_seq = seq
         pub2 = await connect_fast(seed["mqtt"], "probe-pub")
         t0 = time.monotonic()
@@ -109,8 +118,9 @@ async def main(cycles: int) -> None:
                 return
         raise AssertionError(f"anchor got nothing new in {deadline_s}s")
 
-    async def wait_members(n, deadline_s=15.0):
+    async def wait_members(n, deadline_s=None):
         """Invariant 4: membership converges to n running nodes."""
+        deadline_s = (deadline_s or 15.0) * LAX
         from emqx_tpu.cluster.rpc import RpcNode
         probe = RpcNode("probe@x", port=0)
         await probe.start()
