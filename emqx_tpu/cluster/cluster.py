@@ -387,6 +387,12 @@ class ClusterNode:
         return [o for o in self.store.table(T_REGISTRY).origins(clientid)
                 if self.membership.is_running(o)]
 
+    # session wire maps are small; a FROZEN owner (gray failure) must
+    # cost a reconnecting client one short timeout, not the 10s default —
+    # an unanswered takeover means the session is lost to the corpse
+    # either way (same as the owner having died)
+    TAKEOVER_RPC_TIMEOUT_S = 3.0
+
     async def takeover_remote(self, clientid: str) -> Optional[dict]:
         """Pull a session (wire map) from whichever node owns the client."""
         me = self.rpc.node
@@ -394,8 +400,9 @@ class ClusterNode:
             if origin == me:
                 continue
             try:
-                wire = await self.rpc.call(origin, "cm.takeover",
-                                           [clientid], key=clientid)
+                wire = await self.rpc.call(
+                    origin, "cm.takeover", [clientid], key=clientid,
+                    timeout=self.TAKEOVER_RPC_TIMEOUT_S)
             except RpcError:
                 continue
             if wire is not None:
@@ -407,8 +414,9 @@ class ClusterNode:
         for origin in self.registry_lookup(clientid):
             if origin != me:
                 try:
-                    await self.rpc.call(origin, "cm.discard", [clientid],
-                                        key=clientid)
+                    await self.rpc.call(
+                        origin, "cm.discard", [clientid], key=clientid,
+                        timeout=self.TAKEOVER_RPC_TIMEOUT_S)
                 except RpcError:
                     pass
 

@@ -1,21 +1,26 @@
 #!/usr/bin/env python
-"""Cluster chaos drive: random node kills under continuous QoS1 traffic.
+"""Cluster chaos drive: node kills AND freezes under continuous QoS1
+traffic.
 
 The reference's failure story is tested with docker-compose node kills
 (scripts/ + emqx_takeover_SUITE.erl); this is the sharper analog: a
-3-OS-process cluster where a random non-seed node is SIGKILLed mid-flood,
-its clients re-home to a survivor (cross-node takeover of the same
-clientid), the victim is restarted and rejoined, and four invariants are
-asserted every cycle:
+3-OS-process cluster where, each cycle, a random non-seed node is either
+SIGKILLed (crash) or SIGSTOPped (gray failure: TCP open, nothing
+answers) mid-flood. Its clients re-home to a survivor (cross-node
+takeover of the same clientid against the corpse/frozen owner), the
+victim is restarted/thawed, and the invariants asserted every cycle:
 
-  1. CONNECT to any survivor completes fast (<2s) — a dead peer must
-     never park the clientid lock (the half-open RPC channel regression).
+  1. CONNECT to any survivor completes fast — a dead peer must never
+     park the clientid lock; a FROZEN peer costs at most the bounded
+     RPC timeouts (connect/handshake, lock, takeover).
   2. QoS1 publishes keep earning PUBACKs throughout the outage.
   3. The anchor subscriber (on the seed) resumes receiving within the
-     bound after each kill — routes survive peer death.
-  4. After the victim rejoins, membership converges back to 3 running
-     nodes (anti-entropy + autoheal).
+     bound — routes survive peer death.
+  4. After heal/thaw, membership converges back to 3 running nodes.
+  5. A node restarted at NEW dynamic ports is deliverable-to again
+     (peer re-addressing + replication incarnation).
 
+CHAOS_MODE=kill|freeze|mixed (default mixed), CHAOS_SEED, CHAOS_LAX.
 Usage: python tools/chaos_cluster.py [cycles]    (default 6)
 
 Exit 0 with "CHAOS OK" on success; assertion failure otherwise.
@@ -103,14 +108,15 @@ async def main(cycles: int) -> None:
             seq += 1
             await asyncio.sleep(0)
 
-    async def wait_resume(deadline_s=None):
+    async def wait_resume(deadline_s=None, bound_s=None):
         """Invariant 3: the anchor sees NEW messages within the bound."""
         deadline_s = (deadline_s or 8.0) * LAX
         start_seq = seq
-        pub2 = await connect_fast(seed["mqtt"], "probe-pub")
+        pub2 = await connect_fast(seed["mqtt"], "probe-pub",
+                                  bound_s=bound_s)
         t0 = time.monotonic()
         while time.monotonic() - t0 < deadline_s:
-            await publish_burst(pub2, 1)
+            await publish_burst(pub2, 1, bound_s=bound_s)
             await asyncio.sleep(0.1)
             await drain_anchor()
             if any(s >= start_seq for s in received):
@@ -154,6 +160,41 @@ async def main(cycles: int) -> None:
     for cycle in range(cycles):
         victim_name = rng.choice(list(others))
         victim = others[victim_name]
+
+        # mixed mode: some cycles FREEZE (SIGSTOP — gray failure: TCP
+        # open, nothing answers) instead of killing. Bounds are larger:
+        # pre-detection, each RPC against the frozen node costs its
+        # short timeout rather than failing instantly.
+        mode = os.environ.get("CHAOS_MODE", "mixed")
+        freeze = mode == "freeze" or (mode == "mixed"
+                                      and cycle % 3 == 2)
+        if freeze:
+            print(f"[cycle {cycle}] SIGSTOP {victim_name}", flush=True)
+            os.kill(victim["p"].pid, signal.SIGSTOP)
+            try:
+                if pub.port == victim["mqtt"]:
+                    # re-home: same clientid, owner frozen — takeover
+                    # must give up on the corpse within its bound
+                    pub = await connect_fast(seed["mqtt"], "chaos-pub",
+                                             bound_s=8.0)
+                if extra.port == victim["mqtt"]:
+                    extra = await connect_fast(seed["mqtt"], "extra-sub",
+                                               bound_s=8.0)
+                    await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
+                probe = await connect_fast(seed["mqtt"],
+                                           f"frz-{cycle}", bound_s=8.0)
+                await probe.disconnect()
+                await publish_burst(pub, 10, bound_s=8.0)
+                await wait_resume(deadline_s=16.0, bound_s=8.0)
+            finally:
+                os.kill(victim["p"].pid, signal.SIGCONT)
+            await wait_members(3)             # thaw: autoheal
+            await publish_burst(pub, 10)
+            await wait_resume()
+            print(f"[cycle {cycle}] thawed, seq={seq}, "
+                  f"anchor_received={len(received)}", flush=True)
+            continue
+
         print(f"[cycle {cycle}] kill -9 {victim_name}", flush=True)
         victim["p"].kill()
         victim["p"].wait(10)
