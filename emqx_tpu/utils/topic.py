@@ -102,6 +102,16 @@ def validate(topic: str, kind: str = "filter") -> bool:
 
     kind: 'filter' (wildcards allowed) or 'name' (no wildcards).
     """
+    if kind == "name" and topic and "#" not in topic \
+            and "+" not in topic and "\x00" not in topic:
+        # fast path for the publish hot loop: a clean NAME (no wildcard
+        # or NUL bytes anywhere, empty levels allowed) needs no
+        # tokenize/word-walk — only the length bound. Anything that
+        # would be rejected falls through to the slow path so error
+        # reasons stay exact.
+        if len(topic.encode("utf-8")) > MAX_TOPIC_LEN:
+            raise TopicError("topic_too_long", topic)
+        return True
     if kind not in ("filter", "name"):
         raise ValueError(f"kind must be 'filter' or 'name', got {kind!r}")
     if topic == "":
