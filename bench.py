@@ -861,18 +861,25 @@ def main():
                     raise TimeoutError("e2e watchdog")
 
                 signal.signal(signal.SIGALRM, _e2e_alarm)
-                try:
-                    signal.alarm(int(os.environ.get("BENCH_E2E_TIMEOUT_S",
-                                                    600)))
-                    result["e2e_device"] = run_e2e(ef, 16, 8, em // 8, True)
-                    result["e2e_host"] = run_e2e(ef, 16, 8, em // 8, False)
-                except Exception as e:  # noqa: BLE001 — e2e is best-effort
-                    signal.alarm(0)   # see config-suite handler
-                    log(f"e2e bench failed: {type(e).__name__}: {e}")
-                    traceback.print_exc(file=sys.stderr)
-                    result["e2e_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-                finally:
-                    signal.alarm(0)
+                # host first with its own watchdog: it is fast and always
+                # works, so a device run that burns its budget on relay
+                # compiles can no longer take the host number down with it
+                budget = int(os.environ.get("BENCH_E2E_TIMEOUT_S", 600))
+                for name, use_device, share in (("e2e_host", False, 1),
+                                                ("e2e_device", True, 2)):
+                    try:
+                        signal.alarm(budget * share // 3)
+                        result[name] = run_e2e(ef, 16, 8, em // 8,
+                                               use_device)
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        signal.alarm(0)
+                        log(f"{name} bench failed: "
+                            f"{type(e).__name__}: {e}")
+                        traceback.print_exc(file=sys.stderr)
+                        result[f"{name}_error"] = \
+                            f"{type(e).__name__}: {str(e)[:200]}"
+                    finally:
+                        signal.alarm(0)
             print(json.dumps(result), flush=True)
             return
         except Exception as e:  # noqa: BLE001 — always emit a JSON line
