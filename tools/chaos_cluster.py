@@ -50,7 +50,11 @@ def spawn(name, join=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     cmd = [sys.executable, os.path.join(REPO, "tools", "run_node.py"),
-           "--name", name, "--no-device"]
+           "--name", name]
+    if os.environ.get("CHAOS_DEVICE", "0") != "1":
+        cmd.append("--no-device")   # CHAOS_DEVICE=1: serve through the
+        # batcher + device engine (CPU backend) so kills/freezes also
+        # exercise the fused serving path
     if join:
         cmd += ["--join", join]
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
