@@ -214,7 +214,18 @@ class _Channel:
         return reply.get("val")
 
     async def cast(self, fn: str, args: list) -> None:
-        await self.send({"t": "cast", "fn": fn, "args": args})
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT):
+                await self.send({"t": "cast", "fn": fn, "args": args})
+        except asyncio.TimeoutError as e:
+            # a FROZEN peer stops reading: once the TCP buffers fill,
+            # drain() parks forever and would wedge the (single)
+            # replication worker — nodedown can't interrupt an in-flight
+            # drain. The cast is doomed anyway (anti-entropy heals);
+            # close the channel so later sends reconnect or fail fast.
+            if self.writer is not None:
+                self.writer.close()
+            raise RpcError(f"cast {fn}: send timed out") from e
 
     async def close(self) -> None:
         if self._reader_task:

@@ -698,6 +698,45 @@ async def _test_fast_rejoin_purges_ghost_routes():
         await teardown(clusters)
 
 
+def test_cast_to_buffer_full_frozen_peer_bounded(loop):
+    run(loop, _test_cast_frozen_bounded())
+
+
+async def _test_cast_frozen_bounded():
+    """A peer that handshakes then stops reading (frozen, buffers
+    filling) must not park cast() forever: once the kernel buffers fill
+    and drain() blocks, the send bound trips and the channel closes —
+    otherwise the single replication worker wedges on one dead peer."""
+    import time
+
+    from emqx_tpu.cluster import rpc as R
+
+    async def _serve(reader, writer):
+        await R.read_frame(reader)                 # hello
+        writer.write(R.encode_frame({"t": "hello_ok", "node": "frozen"}))
+        await writer.drain()
+        while True:                                # accept, never read
+            await asyncio.sleep(3600)
+
+    server = await asyncio.start_server(_serve, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    ch = R._Channel("127.0.0.1", port, "me@x", "emqxsecretcookie")
+    old_bound = R.CONNECT_TIMEOUT
+    R.CONNECT_TIMEOUT = 1.0
+    try:
+        t0 = time.time()
+        with pytest.raises(R.RpcError):
+            # 1MB payloads fill the socket buffers within a few casts
+            for _ in range(200):
+                await ch.cast("noop", ["x" * (1 << 20)])
+        assert time.time() - t0 < 30, "cast parked on a frozen peer"
+        assert not ch.alive            # channel closed for fast refail
+    finally:
+        R.CONNECT_TIMEOUT = old_bound
+        await ch.close()
+        server.close()
+
+
 def test_rpc_half_open_channel_fails_fast(loop):
     run(loop, _test_rpc_half_open())
 
