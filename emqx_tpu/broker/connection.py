@@ -108,13 +108,20 @@ class Connection:
                     reason = f"frame_error:{e.code}"
                     self._frame_error_out(e)
                     break
-                for pkt in pkts:
+                for i, pkt in enumerate(pkts):
                     try:
                         await self.channel.handle_in(pkt)
                     except ProtocolError as e:
                         reason = f"protocol_error:0x{e.rc:02x}"
                         self._protocol_error_out(e)
                         break
+                    if i % 64 == 63:
+                        # one read can carry hundreds of frames; without
+                        # a scheduling point the whole burst handles
+                        # back-to-back and stalls every other task for
+                        # tens of ms (handle_in's awaits don't yield
+                        # unless they actually block)
+                        await asyncio.sleep(0)
                 if pkts:
                     await self._drain()
                     # ingress rate limit: a depleted bucket pauses reading

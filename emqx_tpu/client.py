@@ -127,8 +127,13 @@ class Client:
                 data = await self._reader.read(65536)
                 if not data:
                     break
-                for pkt in self._parser.feed(data):
+                for i, pkt in enumerate(self._parser.feed(data)):
                     self._handle(pkt)
+                    if i % 64 == 63:
+                        # a 64KB read can carry hundreds of deliveries;
+                        # yield so co-located tasks (broker in-process
+                        # tests/benches) are not starved for the burst
+                        await asyncio.sleep(0)
         except (asyncio.CancelledError, ConnectionResetError):
             pass
         finally:
