@@ -274,7 +274,16 @@ class PublishBatcher:
                     # trickle rates stays where the pre-pipeline drain had
                     # it (SURVEY §7 hard-part 2's dedicated small-batch
                     # path)
-                    self._complete_host(group[0])
+                    try:
+                        await self._complete_host(group[0])
+                    except asyncio.CancelledError:
+                        # now cancellable mid-completion (chunked yields),
+                        # and this entry is in neither the queue nor the
+                        # pipeline — fail it or its publishers strand
+                        self._fail_entry(
+                            group[0],
+                            RuntimeError("publish batcher stopped"))
+                        raise
                     continue
                 for gi, entry in enumerate(group):
                     try:
@@ -326,9 +335,14 @@ class PublishBatcher:
         entry["live_idx"] = live_idx
 
     # ---- consumer: complete batches strictly in order --------------------
-    def _complete_host(self, entry: dict, routed=None) -> None:
+    async def _complete_host(self, entry: dict, routed=None) -> None:
         """Route an entry host-side (or publish a device result) and
-        resolve its futures. Runs on the loop; raises nothing."""
+        resolve its futures. Raises nothing. Yields every 64 routed
+        messages — a 1024-message host fallback otherwise stalls the
+        whole event loop for tens of ms. Safe against reordering: the
+        trickle caller runs in the producer task (nothing can enqueue
+        behind it while it awaits) and the consumer is strictly
+        sequential."""
         batch = entry["batch"]
         counts = [0] * len(batch)
         try:
@@ -337,9 +351,13 @@ class PublishBatcher:
             live, live_idx = entry["live"], entry["live_idx"]
             if routed is None and live:
                 t0 = time.perf_counter()
-                routed = [self.node.broker._route(
-                    m, self.node.broker.router.match(m.topic))
-                    for m in live]
+                routed = []
+                broker = self.node.broker
+                for j, m in enumerate(live):
+                    routed.append(
+                        broker._route(m, broker.router.match(m.topic)))
+                    if j % 64 == 63:
+                        await asyncio.sleep(0)
                 self._host_msg_s = _ewma(
                     self._host_msg_s,
                     (time.perf_counter() - t0) / len(live))
@@ -372,7 +390,7 @@ class PublishBatcher:
                 routed = None
                 if entry.get("handle") is not None and "error" not in entry:
                     routed = await self._complete_device(entry, loop)
-                self._complete_host(entry, routed)
+                await self._complete_host(entry, routed)
             except asyncio.CancelledError:
                 self._fail_entry(entry,
                                  RuntimeError("publish batcher stopped"))

@@ -62,7 +62,9 @@ def spawn(name, join=None):
     line = _readline_deadline(p, 60).strip()
     assert line.startswith("READY "), f"{name}: {line}"
     _, mqtt, rpc = line.split()
-    return {"p": p, "mqtt": int(mqtt), "rpc": int(rpc), "name": name}
+    rec = {"p": p, "mqtt": int(mqtt), "rpc": int(rpc), "name": name}
+    _ALL_PROCS.append(rec)
+    return rec
 
 
 async def connect_fast(port, clientid, bound_s=None):
@@ -87,6 +89,7 @@ async def main(cycles: int) -> None:
     others = {"b@127.0.0.1": b, "c@127.0.0.1": c}
     procs = [seed, b, c]
     rng = random.Random(int(os.environ.get("CHAOS_SEED", 42)))
+    clients: list = []
 
     anchor = await connect_fast(seed["mqtt"], "anchor")
     await anchor.subscribe([("chaos/#", P.SubOpts(qos=1))])
@@ -252,21 +255,37 @@ async def main(cycles: int) -> None:
                         f"{missing[:10]}..."
     print(f"CHAOS OK: {cycles} cycles, {seq} published, "
           f"{len(received)} received, 0 lost", flush=True)
-
     for cl in (anchor, pub, extra):
         try:
             await cl.disconnect()
         except Exception:  # noqa: BLE001
             pass
-    for pr in procs:
+
+
+def _reap():
+    """Kill every node this drive spawned — an assertion failure must
+    not leak broker processes onto the box (leaked nodes kept beating
+    and skewed later benchmarks). SIGCONT first so a frozen victim's
+    kill takes effect immediately."""
+    for pr in _ALL_PROCS:
         if pr["p"].poll() is None:
-            pr["p"].send_signal(signal.SIGTERM)
-    for pr in procs:
-        try:
-            pr["p"].wait(10)
-        except subprocess.TimeoutExpired:
+            try:
+                os.kill(pr["p"].pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
             pr["p"].kill()
+    for pr in _ALL_PROCS:
+        try:
+            pr["p"].wait(5)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_ALL_PROCS: list = []
 
 
 if __name__ == "__main__":
-    asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 6))
+    try:
+        asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 6))
+    finally:
+        _reap()
