@@ -131,10 +131,39 @@ class Node:
             self.listeners.append(lst)
         return self.listeners
 
+    async def start_dashboard(self):
+        """Boot the mgmt REST API + web dashboard from config (the
+        reference's emqx_dashboard http listener, default port 18083).
+        Opt-in: requires a `dashboard` config section; disable with
+        `dashboard.enable = false`. The full /api/v5 surface and the
+        single-file UI share one server; everything except the UI page
+        and /api/v5/login sits behind the admin token/basic auth."""
+        dc = self.config.get("dashboard") or {}
+        if not dc or dc.get("enable") is False:
+            return None
+        from emqx_tpu.apps.dashboard import DashboardAdmin, register_api
+        from emqx_tpu.mgmt import Mgmt, make_api
+        lc = (dc.get("listeners") or {}).get("http") or {}
+        cluster = getattr(self.broker, "cluster", None)
+        admin = DashboardAdmin(self)
+        mgmt = Mgmt(self, cluster)
+        srv = make_api(self, mgmt, cluster=cluster,
+                       host=str(lc.get("bind", "127.0.0.1")),
+                       port=int(lc.get("port", 18083)))
+        srv.auth_check = admin.auth_check
+        register_api(srv, self, admin, mgmt)
+        await srv.start()
+        self.dashboard_server = srv
+        return srv
+
     async def stop_listeners(self) -> None:
         for lst in self.listeners:
             await lst.stop()
         self.listeners.clear()
+        srv = getattr(self, "dashboard_server", None)
+        if srv is not None:
+            await srv.stop()
+            self.dashboard_server = None
 
     # ---- periodic housekeeping (the reference's per-subsystem timers:
     #      session expiry, retained expiry scan, delayed fire, stats) ----

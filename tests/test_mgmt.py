@@ -439,3 +439,58 @@ class TestApiHardening:
             import os
             assert any(True for _r, _d, fs in os.walk(tmp_path)
                        for _f in fs), "profiler wrote nothing"
+
+
+class TestConfigDrivenDashboard:
+    def test_boot_dashboard_from_config(self, loop, tmp_path):
+        """Node.start_dashboard boots the reference-shaped dashboard
+        listener from config: one server carrying the web UI, the token
+        login flow, and the full /api/v5 REST surface behind admin
+        auth — exactly what the single-file UI drives."""
+        conf = tmp_path / "emqx.conf"
+        conf.write_text("""
+        listeners { t { type = tcp, bind = "127.0.0.1", port = 0 } }
+        dashboard { listeners { http { bind = "127.0.0.1", port = 0 } } }
+        """)
+        node = Node.from_config_file(str(conf))
+        run(loop, node.start_listeners())
+        srv = run(loop, node.start_dashboard())
+        assert srv is not None
+
+        async def req(method, path, body=None, bearer=None):
+            r, w = await asyncio.open_connection("127.0.0.1", srv.port)
+            data = json.dumps(body).encode() if body is not None else b""
+            hdrs = [f"{method} {path} HTTP/1.1", "host: x",
+                    f"content-length: {len(data)}", "connection: close"]
+            if bearer:
+                hdrs.append(f"authorization: Bearer {bearer}")
+            w.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + data)
+            await w.drain()
+            raw = await r.read(-1)
+            w.close()
+            head, _, payload = raw.partition(b"\r\n\r\n")
+            status = int(head.split()[1])
+            ctype = b"application/json" in head
+            return status, (json.loads(payload) if ctype and payload
+                            else payload)
+
+        async def go():
+            # UI page is served unauthenticated
+            st, page = await req("GET", "/")
+            assert st == 200 and b"dashboard" in page
+            # API requires auth
+            st, _ = await req("GET", "/api/v5/overview")
+            assert st == 401
+            # token flow exactly as the UI drives it
+            st, body = await req("POST", "/api/v5/login",
+                                 {"username": "admin",
+                                  "password": "public"})
+            assert st == 200 and body.get("token")
+            tok = body["token"]
+            for path in ("/api/v5/overview", "/api/v5/clients?_limit=5",
+                         "/api/v5/subscriptions?_limit=5",
+                         "/api/v5/stats"):
+                st, _ = await req("GET", path, bearer=tok)
+                assert st == 200, f"{path} -> {st}"
+        run(loop, go())
+        run(loop, node.stop_listeners())

@@ -71,6 +71,10 @@ async def main() -> None:
         await cn.join(*join_addr)
 
     node.start_timers()
+    if args.config:
+        # config-driven mgmt REST + dashboard (after cluster start so
+        # the API sees the cluster view)
+        await node.start_dashboard()
     print(f"READY {mqtt_port} {cn.address[1]}", flush=True)
 
     stop = asyncio.Event()
