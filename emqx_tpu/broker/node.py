@@ -156,10 +156,33 @@ class Node:
         self.dashboard_server = srv
         return srv
 
+    async def start_gateways(self) -> list:
+        """Boot protocol gateways from the `gateway` config section
+        (emqx_gateway.erl loads gateway.stomp/mqttsn/coap/lwm2m/exproto
+        blocks the same way). Each block: enable (default true) + the
+        gateway's own options (bind/port/...)."""
+        from emqx_tpu.gateway.registry import GatewayRegistry
+        reg = getattr(self, "gateway_registry", None)
+        if reg is None:
+            reg = GatewayRegistry.with_builtins(self)
+        started = []
+        for name, conf in (self.config.get("gateway") or {}).items():
+            if not isinstance(conf, dict) or conf.get("enable") is False:
+                continue
+            started.append(await reg.load(name, conf))
+        return started
+
+    async def stop_gateways(self) -> None:
+        reg = getattr(self, "gateway_registry", None)
+        if reg is not None:
+            for name in [n for n in reg._instances]:
+                await reg.unload(name)
+
     async def stop_listeners(self) -> None:
         for lst in self.listeners:
             await lst.stop()
         self.listeners.clear()
+        await self.stop_gateways()
         srv = getattr(self, "dashboard_server", None)
         if srv is not None:
             await srv.stop()

@@ -744,3 +744,43 @@ class TestLwm2mObjectRegistry:
             == "/3303/0/5700"
         assert reg.resource(3303, 5700).type == "Float"
         assert reg.load_xml_dir(str(tmp_path)) == 1
+
+
+class TestConfigDrivenGateways:
+    def test_boot_gateways_from_config(self, loop, tmp_path):
+        """Node.start_gateways boots the `gateway` config section the way
+        emqx_gateway loads its blocks; a STOMP client then talks to the
+        config-booted gateway end to end, and disabled blocks stay off."""
+        conf = tmp_path / "emqx.conf"
+        conf.write_text("""
+        listeners { t { type = tcp, bind = "127.0.0.1", port = 0 } }
+        gateway {
+          stomp  { bind = "127.0.0.1", port = 0 }
+          mqttsn { bind = "127.0.0.1", port = 0, enable = false }
+        }
+        """)
+        node = Node.from_config_file(str(conf))
+        run(loop, node.start_listeners())
+        started = run(loop, node.start_gateways())
+        try:
+            assert [type(g).__name__ for g in started] == ["StompGateway"]
+            assert node.gateway_registry.lookup("stomp") is started[0]
+            assert node.gateway_registry.lookup("mqttsn") is None
+
+            async def go():
+                c = StompClient(started[0].port)
+                await c.connect()
+                await c.send(Frame("SUBSCRIBE",
+                                   {"id": "s1", "destination": "cfg/t",
+                                    "receipt": "r1"}))
+                r = await c.recv()
+                assert r.command == "RECEIPT"
+                from emqx_tpu.broker.message import make
+                node.broker.publish(make("mq", 0, "cfg/t", b"cfg-boot"))
+                m = await c.recv()
+                assert m.body == b"cfg-boot"
+                c.close()
+            run(loop, go())
+        finally:
+            run(loop, node.stop_listeners())
+        assert node.gateway_registry.lookup("stomp") is None  # stopped

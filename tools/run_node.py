@@ -72,9 +72,10 @@ async def main() -> None:
 
     node.start_timers()
     if args.config:
-        # config-driven mgmt REST + dashboard (after cluster start so
-        # the API sees the cluster view)
+        # config-driven mgmt REST + dashboard + gateways (after cluster
+        # start so the API sees the cluster view)
         await node.start_dashboard()
+        await node.start_gateways()
     print(f"READY {mqtt_port} {cn.address[1]}", flush=True)
 
     stop = asyncio.Event()
