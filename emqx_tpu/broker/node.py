@@ -156,6 +156,13 @@ class Node:
         self.dashboard_server = srv
         return srv
 
+    async def start_apps(self) -> list:
+        """Boot every feature app the config declares (retainer, delayed,
+        rewrite, rule engine, authn/authz chains, exhook) — the release
+        application-start analog. See apps/boot.py for the surface."""
+        from emqx_tpu.apps.boot import start_apps
+        return await start_apps(self)
+
     async def start_gateways(self) -> list:
         """Boot protocol gateways from the `gateway` config section
         (emqx_gateway.erl loads gateway.stomp/mqttsn/coap/lwm2m/exproto

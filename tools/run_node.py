@@ -72,8 +72,9 @@ async def main() -> None:
 
     node.start_timers()
     if args.config:
-        # config-driven mgmt REST + dashboard + gateways (after cluster
-        # start so the API sees the cluster view)
+        # config-driven feature apps + mgmt REST + dashboard + gateways
+        # (after cluster start so the API sees the cluster view)
+        await node.start_apps()
         await node.start_dashboard()
         await node.start_gateways()
     print(f"READY {mqtt_port} {cn.address[1]}", flush=True)
