@@ -143,6 +143,69 @@ def test_cross_process_pubsub(two_nodes):
     asyncio.run(go())
 
 
+def test_autocluster_static_discovery(tmp_path):
+    """Two processes with `cluster { discovery = static }` config and no
+    explicit --join must find each other (run_node drives autocluster);
+    proven by cross-node delivery."""
+    import time
+
+    confs = {}
+    for name, my_rpc, peer_rpc in (("a", 17771, 17772),
+                                   ("b", 17772, 17771)):
+        c = tmp_path / f"{name}.conf"
+        c.write_text(f"""
+        listeners {{ t {{ type = tcp, bind = "127.0.0.1", port = 0 }} }}
+        cluster {{ discovery = static,
+                   nodes = ["127.0.0.1:{peer_rpc}"] }}
+        """)
+        confs[name] = (str(c), my_rpc)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = []
+    try:
+        ports = {}
+        for name, (conf, rpc) in confs.items():
+            p = subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "run_node.py"),
+                 "--name", f"{name}@127.0.0.1", "--no-device",
+                 "--config", conf, "--rpc-port", str(rpc)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                env=env)
+            procs.append(p)
+            line = _readline_deadline(p, 60).strip()
+            assert line.startswith("READY "), line
+            ports[name] = int(line.split()[1])
+
+        async def go():
+            from emqx_tpu.client import Client
+            from emqx_tpu.mqtt import packet as P
+            sub = Client(port=ports["a"], clientid="s")
+            await sub.connect()
+            await sub.subscribe([("auto/#", P.SubOpts(qos=0))])
+            pub = Client(port=ports["b"], clientid="p")
+            await pub.connect()
+            got = None
+            for i in range(150):
+                await pub.publish(f"auto/{i}", b"x", qos=0)
+                try:
+                    got = await asyncio.wait_for(sub.messages.get(), 0.2)
+                    break
+                except asyncio.TimeoutError:
+                    pass
+            assert got is not None, "autocluster never joined"
+        asyncio.run(go())
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 def test_gray_failure_frozen_peer(two_nodes):
     """SIGSTOP (gray failure: TCP open, node unresponsive) must not park
     CONNECT on the survivor: the clientid-lock RPC and the heartbeat

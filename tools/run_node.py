@@ -69,6 +69,11 @@ async def main() -> None:
     await cn.start()
     if join_addr:
         await cn.join(*join_addr)
+    elif (node.config.get("cluster") or {}).get("discovery",
+                                                "manual") != "manual":
+        # config-driven autocluster (static/dns/etcd/k8s/mcast seeds)
+        from emqx_tpu.cluster.discovery import autocluster
+        await autocluster(cn)
 
     node.start_timers()
     if args.config:
