@@ -65,7 +65,14 @@ async def main() -> None:
         node.listeners.append(lst)
         mqtt_port = lst.port
 
-    cn = ClusterNode(node, port=args.rpc_port)
+    rpc_conf = node.config.get("rpc") or {}
+    cluster_conf = node.config.get("cluster") or {}
+    cn = ClusterNode(node, port=args.rpc_port,
+                     cookie=cluster_conf.get("cookie",
+                                             "emqxsecretcookie"),
+                     rpc_mode=rpc_conf.get("mode", "async"))
+    if rpc_conf.get("tcp_client_num"):
+        cn.rpc.n_channels = int(rpc_conf["tcp_client_num"])
     await cn.start()
     if join_addr:
         await cn.join(*join_addr)
