@@ -111,3 +111,54 @@ def test_start_apps_nothing_configured(loop):
     apps = run(loop, node.start_apps())
     assert [type(a).__name__ for a in apps] == ["Retainer",
                                                 "DelayedPublish"]
+
+
+def test_boot_db_backed_authn_from_config(loop, tmp_path):
+    """The boot factory's DB arm: a config-declared MySQL authenticator
+    builds its typed resource from the same config block and enforces
+    CONNECT credentials against a live (fake) wire-protocol server."""
+    from emqx_tpu.utils import passwd as PW
+    from tests.fake_db import FakeMysql
+
+    def _hash(pw):   # sha256, salt prefix (the default algorithm config)
+        return PW.hash_password("sha256", pw.encode(), "s1", "prefix")
+
+    def handler(sql):
+        # the connector uses server-side prepared statements, so the
+        # fake sees `?` placeholders — return dbu's row; the password
+        # hash check is what enforces
+        assert "?" in sql, f"expected a prepared statement, got {sql!r}"
+        return (["password_hash", "salt", "is_superuser"],
+                [[_hash("dbpw"), "s1", "0"]])
+
+    async def go():
+        srv = await FakeMysql(handler=handler).start()
+        conf = tmp_path / "emqx.conf"
+        conf.write_text(f"""
+        listeners {{ t {{ type = tcp, bind = "127.0.0.1", port = 0 }} }}
+        authn {{
+          enable = true
+          chain = [ {{ mechanism = password_based, backend = mysql,
+                       port = {srv.port}, password = "",
+                       query = "SELECT password_hash, salt, is_superuser \
+FROM mqtt_user WHERE username = ${{mqtt-username}}" }} ]
+        }}
+        """)
+        node = Node.from_config_file(str(conf), use_device=False)
+        apps = await node.start_apps()
+        assert "AuthnChain" in [type(a).__name__ for a in apps]
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        await lst.start()
+
+        bad = Client(port=lst.port, clientid="b", username="dbu",
+                     password=b"wrong")
+        with pytest.raises(MqttError):
+            await bad.connect(timeout=5)
+        good = Client(port=lst.port, clientid="g", username="dbu",
+                      password=b"dbpw")
+        await good.connect()
+        await good.disconnect()
+        await lst.stop()
+        await node.resources.remove("authn_0_mysql")
+        await srv.stop()
+    run(loop, go())
