@@ -50,8 +50,7 @@ async def _db_resource(node, rid: str, rtype: str, conf: dict):
     from emqx_tpu.resources.resource import ResourceManager
     mgr = getattr(node, "resources", None)
     if mgr is None:
-        mgr = ResourceManager(node)
-        node.resources = mgr
+        mgr = ResourceManager(node)   # registers itself as node.resources
     return await mgr.create(rid, rtype, conf)
 
 

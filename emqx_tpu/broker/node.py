@@ -182,7 +182,7 @@ class Node:
     async def stop_gateways(self) -> None:
         reg = getattr(self, "gateway_registry", None)
         if reg is not None:
-            for name in [n for n in reg._instances]:
+            for name in list(reg._instances):
                 await reg.unload(name)
 
     async def stop_listeners(self) -> None:

@@ -29,7 +29,7 @@ def run(loop, coro):
     return loop.run_until_complete(asyncio.wait_for(coro, 30))
 
 
-async def http(port, method, path, body=None, auth=None):
+async def http(port, method, path, body=None, auth=None, bearer=None):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
     data = json.dumps(body).encode() if body is not None else b""
     hdrs = [f"{method} {path} HTTP/1.1", "host: x",
@@ -37,12 +37,16 @@ async def http(port, method, path, body=None, auth=None):
     if auth:
         tok = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
         hdrs.append(f"authorization: Basic {tok}")
+    if bearer:
+        hdrs.append(f"authorization: Bearer {bearer}")
     writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + data)
     await writer.drain()
     raw = await reader.read(-1)
     writer.close()
     head, _, payload = raw.partition(b"\r\n\r\n")
     status = int(head.split()[1])
+    if b"application/json" not in head:
+        return status, payload          # e.g. the dashboard HTML page
     return status, json.loads(payload) if payload else None
 
 
@@ -458,21 +462,8 @@ class TestConfigDrivenDashboard:
         assert srv is not None
 
         async def req(method, path, body=None, bearer=None):
-            r, w = await asyncio.open_connection("127.0.0.1", srv.port)
-            data = json.dumps(body).encode() if body is not None else b""
-            hdrs = [f"{method} {path} HTTP/1.1", "host: x",
-                    f"content-length: {len(data)}", "connection: close"]
-            if bearer:
-                hdrs.append(f"authorization: Bearer {bearer}")
-            w.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + data)
-            await w.drain()
-            raw = await r.read(-1)
-            w.close()
-            head, _, payload = raw.partition(b"\r\n\r\n")
-            status = int(head.split()[1])
-            ctype = b"application/json" in head
-            return status, (json.loads(payload) if ctype and payload
-                            else payload)
+            return await http(srv.port, method, path, body=body,
+                              bearer=bearer)
 
         async def go():
             # UI page is served unauthenticated

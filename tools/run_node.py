@@ -76,8 +76,7 @@ async def main() -> None:
     await cn.start()
     if join_addr:
         await cn.join(*join_addr)
-    elif (node.config.get("cluster") or {}).get("discovery",
-                                                "manual") != "manual":
+    elif cluster_conf.get("discovery", "manual") != "manual":
         # config-driven autocluster (static/dns/etcd/k8s/mcast seeds)
         from emqx_tpu.cluster.discovery import autocluster
         await autocluster(cn)
