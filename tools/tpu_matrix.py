@@ -51,7 +51,9 @@ def main():
     t0 = time.time()
     shapes = build_shape_tables(fs["rows"], fs["lens"])
     out["table_build_s"] = round(time.time() - t0, 2)
-    log(f"build {out['table_build_s']}s")
+    out["table_mb"] = round(sum(np.asarray(v).nbytes
+                                for v in shapes) / 1e6)
+    log(f"build {out['table_build_s']}s {out['table_mb']}MB")
 
     F = fs["ids"] * fs["nums"]
     n_shared = F // 2
