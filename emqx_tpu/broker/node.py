@@ -194,6 +194,13 @@ class Node:
         if srv is not None:
             await srv.stop()
             self.dashboard_server = None
+        # resources created by the config boot (DB-backed authn/authz):
+        # close their pools + health loop or their sockets outlive the node
+        mgr = getattr(self, "resources", None)
+        if mgr is not None:
+            mgr.stop_health_checks()
+            for rid in list(mgr.instances):
+                await mgr.remove(rid)
 
     # ---- periodic housekeeping (the reference's per-subsystem timers:
     #      session expiry, retained expiry scan, delayed fire, stats) ----

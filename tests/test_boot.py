@@ -149,6 +149,7 @@ FROM mqtt_user WHERE username = ${{mqtt-username}}" }} ]
         assert "AuthnChain" in [type(a).__name__ for a in apps]
         lst = Listener(node, bind="127.0.0.1", port=0)
         await lst.start()
+        node.listeners.append(lst)
 
         bad = Client(port=lst.port, clientid="b", username="dbu",
                      password=b"wrong")
@@ -158,7 +159,7 @@ FROM mqtt_user WHERE username = ${{mqtt-username}}" }} ]
                       password=b"dbpw")
         await good.connect()
         await good.disconnect()
-        await lst.stop()
-        await node.resources.remove("authn_0_mysql")
+        await node.stop_listeners()   # also closes boot-created resources
+        assert not node.resources.instances
         await srv.stop()
     run(loop, go())
