@@ -20,7 +20,8 @@ victim is restarted/thawed, and the invariants asserted every cycle:
   5. A node restarted at NEW dynamic ports is deliverable-to again
      (peer re-addressing + replication incarnation).
 
-CHAOS_MODE=kill|freeze|mixed (default mixed), CHAOS_SEED, CHAOS_LAX.
+CHAOS_MODE=kill|freeze|mixed (default mixed), CHAOS_SEED, CHAOS_LAX,
+CHAOS_QOS=2 (drive at QoS 2 and assert exactly-once), CHAOS_DEVICE=1.
 Usage: python tools/chaos_cluster.py [cycles]    (default 6)
 
 Exit 0 with "CHAOS OK" on success; assertion failure otherwise.
@@ -43,6 +44,9 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 # 5 broker processes on a small box) honest 2s bounds flake, so the
 # in-suite wrapper runs with CHAOS_LAX=3
 LAX = float(os.environ.get("CHAOS_LAX", "1"))
+# CHAOS_QOS=2 runs the whole drive at QoS 2: the anchor then also
+# asserts EXACTLY-once (a duplicate delivery fails the run)
+QOS = int(os.environ.get("CHAOS_QOS", "1"))
 
 
 def spawn(name, join=None):
@@ -92,15 +96,19 @@ async def main(cycles: int) -> None:
     clients: list = []
 
     anchor = await connect_fast(seed["mqtt"], "anchor")
-    await anchor.subscribe([("chaos/#", P.SubOpts(qos=1))])
+    await anchor.subscribe([("chaos/#", P.SubOpts(qos=QOS))])
 
     seq = 0
     received: set = set()
+    dupes: list = []
 
     async def drain_anchor():
         while not anchor.messages.empty():
             m = anchor.messages.get_nowait()
-            received.add(int(m.payload))
+            n = int(m.payload)
+            if n in received and QOS == 2:
+                dupes.append(n)
+            received.add(n)
 
     async def publish_burst(cl, n, bound_s=None):
         """Invariant 2: every QoS1 publish earns its PUBACK in bound."""
@@ -108,7 +116,7 @@ async def main(cycles: int) -> None:
         nonlocal seq
         for _ in range(n):
             t0 = time.monotonic()
-            await cl.publish("chaos/t", str(seq).encode(), qos=1,
+            await cl.publish("chaos/t", str(seq).encode(), qos=QOS,
                              timeout=bound_s + 2)
             dt = time.monotonic() - t0
             assert dt < bound_s, f"PUBACK took {dt:.1f}s"
@@ -253,6 +261,7 @@ async def main(cycles: int) -> None:
     missing = [s for s in range(seq) if s not in received]
     assert not missing, f"anchor lost {len(missing)} messages: " \
                         f"{missing[:10]}..."
+    assert not dupes, f"QoS2 duplicates delivered: {dupes[:10]}"
     print(f"CHAOS OK: {cycles} cycles, {seq} published, "
           f"{len(received)} received, 0 lost", flush=True)
     for cl in (anchor, pub, extra):
