@@ -93,6 +93,8 @@ class PublishBatcher:
         # assume (SURVEY §7 hard-part 2's adaptive micro-batching).
         self._dev_batch_s: Optional[float] = None    # per device batch
         self._host_msg_s: Optional[float] = None     # per host message
+        self._dev_spike = 0       # consecutive-outlier streaks (_ewma)
+        self._host_spike = 0
         self._since_probe = 0         # host batches since last device try
         self._since_host_probe = 0    # device batches since last host probe
         self._last_dev_done: Optional[float] = None
@@ -358,9 +360,10 @@ class PublishBatcher:
                         broker._route(m, broker.router.match(m.topic)))
                     if j % 64 == 63:
                         await asyncio.sleep(0)
-                self._host_msg_s = _ewma(
+                self._host_msg_s, self._host_spike = _ewma(
                     self._host_msg_s,
-                    (time.perf_counter() - t0) / len(live))
+                    (time.perf_counter() - t0) / len(live),
+                    self._host_spike)
                 # a host completion breaks the device completion chain:
                 # the next device sample must be a full round-trip, not
                 # completion-to-completion across this host batch
@@ -438,19 +441,21 @@ class PublishBatcher:
             else:
                 sample = (done - (handle.t0 or done)) / n_subs
             self._last_dev_done = done
-            self._dev_batch_s = _ewma(self._dev_batch_s, sample)
+            self._dev_batch_s, self._dev_spike = _ewma(
+                self._dev_batch_s, sample, self._dev_spike)
             # slow-start growth: this window completed, widen the next
             self._fuse_cwnd = min(8, max(2, 2 * n_subs))
         return counts
 
-    def _device_worth_it(self, n: int, n_subs: int = 1) -> bool:
+    def _device_worth_it(self, n: int) -> bool:
         """Measured-cost routing choice with active probes BOTH ways: the
         device is re-tried every _PROBE_EVERY host batches, and the host is
         re-sampled every host_probe_every device batches (otherwise the host
         estimate starves under steady device load and the bypass can never
-        engage — round-2 weak #2). `n` is the total live messages across
-        the window's `n_subs` sub-batches; _dev_batch_s is the amortized
-        per-sub-batch completion cost."""
+        engage — round-2 weak #2). The decision runs on the FIRST batch of
+        a prospective window (n = its live count) before any fusion;
+        _dev_batch_s is the amortized per-sub-batch completion cost, so the
+        single-sub-batch comparison is the per-sub-batch comparison."""
         if self._dev_batch_s is None:
             return True      # optimistic: measure the device first
         if self._host_msg_s is None \
@@ -466,24 +471,29 @@ class PublishBatcher:
         if self._since_probe >= _PROBE_EVERY:
             self._since_probe = 0
             return True
-        if n_subs * self._dev_batch_s <= n * self._host_msg_s:
+        if self._dev_batch_s <= n * self._host_msg_s:
             return True
         self.node.metrics.inc("routing.device.bypassed")
         self._fuse_cwnd = 1      # re-enter fusion carefully next time
         return False
 
 
-def _ewma(cur: Optional[float], sample: float,
-          alpha: float = 0.2) -> float:
-    """Cost estimate: pessimize FAST, optimize slow. A sample far above
-    the estimate is adopted outright — staying optimistic about a path
-    that just measured 3x slower sends live traffic down the slow path
-    for many more batches (the old 5x clamp made the estimate crawl for
-    ~8 windows after warmup bias). A wrongly-pessimized estimate
-    self-corrects: the active probes re-measure both paths on a bounded
-    cadence."""
+def _ewma(cur: Optional[float], sample: float, streak: int = 0,
+          alpha: float = 0.2) -> tuple[Optional[float], int]:
+    """Cost estimate: pessimize fast — but not on ONE bad sample. A first
+    sample >3x the estimate is DISCARDED (estimate unchanged) and arms the
+    outlier streak; a second consecutive >3x sample — still measured
+    against the same un-drifted baseline — is a sustained slowdown and is
+    adopted outright. A lone spike (GC pause, one relay hiccup) can no
+    longer rewrite a path's cost and misroute traffic for up to
+    _PROBE_EVERY batches; a real 3x+ slowdown is adopted on its second
+    window. A wrongly-pessimized estimate still self-corrects: the active
+    probes re-measure both paths on a bounded cadence.
+    Returns (estimate, outlier_streak)."""
     if cur is None:
-        return sample
+        return sample, 0
     if sample > 3 * cur:
-        return sample
-    return (1 - alpha) * cur + alpha * sample
+        if streak >= 1:
+            return sample, streak + 1
+        return cur, 1
+    return (1 - alpha) * cur + alpha * sample, 0

@@ -511,7 +511,23 @@ class ClusterNode:
         return False
 
     def lock(self, clientid: str):
-        """Async ctx manager: leased lock on the majority prefix."""
+        """Async ctx manager: leased lock on the responsive prefix.
+
+        Exclusion model (and its limit): each contender acquires on every
+        REACHABLE target and waits out contention on any reachable-but-held
+        target; unreachable targets are skipped.  Under SYMMETRIC failure
+        both contenders serialize on the common responsive prefix.  Under
+        ASYMMETRIC reachability (A reaches X, B does not) the two contenders
+        can hold disjoint target sets and both proceed — mutual exclusion
+        then rests only on the LOCK_LEASE_S lease, so the overlap window is
+        bounded but nonzero.  This mirrors the availability bias of the
+        reference's per-client locker (ekka_locker via emqx_cm_locker.erl):
+        a takeover that double-runs is recoverable (the session migrates
+        twice), whereas requiring a strict quorum would block ALL takeovers
+        for a clientid whenever half the lock targets are down — the wrong
+        trade for a 2-node cluster.  If stricter exclusion is ever needed,
+        raise the bar here to a majority of _lock_targets().
+        """
         cluster = self
 
         class _Guard:

@@ -58,10 +58,37 @@ class RedisResource(_PooledDbResource):
         c = self.conf
         if c.get("redis_type") == "cluster" or c.get("cluster_nodes"):
             # emqx_connector_redis.erl cluster mode: servers seed the
-            # slot-routed cluster client (eredis_cluster)
+            # slot-routed cluster client (eredis_cluster).  Seeds come from
+            # cluster_nodes, else the reference-style `servers` list, else
+            # host/port — an empty seed list is a config error, caught here
+            # rather than as a cryptic connect failure later.
             from emqx_tpu.connectors.redis import ClusterRedisClient
+
+            def parse_seeds(raw):
+                # accepts a list of (host, port) pairs or "host:port"
+                # strings, or the reference-style single comma-separated
+                # "h1:6379,h2:6379" string
+                if isinstance(raw, str):
+                    raw = [s for s in raw.split(",") if s.strip()]
+                out = []
+                for s in raw or []:
+                    if isinstance(s, str):
+                        host, _, port = s.strip().partition(":")
+                        out.append((host, int(port or 6379)))
+                    else:
+                        out.append((s[0], int(s[1])))
+                return out
+
+            seeds = parse_seeds(c.get("cluster_nodes")) \
+                or parse_seeds(c.get("servers"))
+            if not seeds and c.get("host"):
+                seeds.append((c["host"], int(c.get("port", 6379))))
+            if not seeds:
+                raise ValueError(
+                    "redis cluster resource needs seed nodes: set "
+                    "cluster_nodes, servers, or host/port")
             return ClusterRedisClient(
-                startup_nodes=[tuple(s) for s in c.get("cluster_nodes", [])],
+                startup_nodes=seeds,
                 username=c.get("username"), password=c.get("password"),
                 ssl=c.get("ssl"))
         if c.get("redis_type") == "sentinel" or c.get("sentinels"):

@@ -293,16 +293,31 @@ class TestAdaptiveDeviceChoice:
         assert b._device_worth_it(4)
 
     def test_ewma_pessimizes_fast_optimizes_slow(self):
-        """Cost estimates adopt a big upward surprise outright (staying
-        optimistic about a path that measured 3x slower keeps live
-        traffic on the slow path), but improve smoothly (one fast sample
-        must not hide a generally slow path — the probes re-measure)."""
+        """Cost estimates pessimize fast but not on ONE bad sample: a
+        first >3x outlier folds in smoothly and arms the streak; a
+        SECOND consecutive outlier (sustained slowdown) is adopted
+        outright. Improvements are always smooth (one fast sample must
+        not hide a generally slow path — the probes re-measure)."""
         from emqx_tpu.broker.batcher import _ewma
         cur = 0.010
-        assert _ewma(cur, 30.0) == 30.0          # adopted, not clamped
-        fast = _ewma(cur, 0.001)                 # improvement is smooth
+        # one spike: discarded, streak armed — baseline must NOT drift or
+        # a sustained 3-4x slowdown would never trip the second check
+        v1, s1 = _ewma(cur, 30.0)
+        assert s1 == 1 and v1 == cur
+        # second consecutive outlier: adopted outright
+        v2, s2 = _ewma(v1, 30.0, s1)
+        assert v2 == 30.0 and s2 == 2
+        # a sustained moderate (3.5x) slowdown adopts on its second window
+        w1, t1 = _ewma(0.010, 0.035)
+        w2, t2 = _ewma(w1, 0.035, t1)
+        assert (w2, t2) == (0.035, 2)
+        # a normal sample disarms the streak
+        _v3, s3 = _ewma(cur, 0.011, 1)
+        assert s3 == 0
+        # improvement is smooth
+        fast, _ = _ewma(cur, 0.001)
         assert 0.005 < fast < cur
-        assert _ewma(None, 0.5) == 0.5
+        assert _ewma(None, 0.5) == (0.5, 0)
 
 
 class TestInternBounded:
