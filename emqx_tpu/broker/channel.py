@@ -97,6 +97,8 @@ class Channel:
         self.keepalive: int = 0
         self.will_msg: Optional[Message] = None
         self.alias_in: dict[int, str] = {}   # v5 inbound topic aliases
+        self.alias_out: dict[str, int] = {}  # v5 outbound: topic -> alias
+        self.alias_out_max = 0               # client's Topic-Alias-Maximum
         self.connected_at: int = 0
         self.disconnect_reason: Optional[str] = None
         self._aborted = False     # server-initiated DISCONNECT sent; no
@@ -217,6 +219,16 @@ class Channel:
             "conn_props": props,
         }
 
+        # --- will capability caps (emqx_mqtt_caps check via emqx_channel
+        #     check_connect: a will above the zone's QoS/retain caps refuses
+        #     the CONNECT outright — MQTT-3.2.2-12 / MQTT-3.2.2-13)
+        if pkt.will is not None:
+            if pkt.will.qos > self.mqtt.get("max_qos_allowed", 2):
+                return self._connack_error(C.RC_QOS_NOT_SUPPORTED)
+            if pkt.will.retain and not self.mqtt.get("retain_available",
+                                                     True):
+                return self._connack_error(C.RC_RETAIN_NOT_SUPPORTED)
+
         # --- banned check (emqx_banned:check in emqx_channel:authenticate)
         banned = getattr(self.node, "banned", None)
         if banned is not None and banned.check(self.clientinfo):
@@ -329,6 +341,12 @@ class Channel:
         self.node.metrics.inc("client.connected")
         self.node.hooks.run("client.connected", (self.clientinfo, self.info()))
 
+        # --- outbound topic aliasing (emqx_channel packing_alias): the
+        #     client's Topic-Alias-Maximum caps how many aliases WE may
+        #     assign on deliveries to it
+        self.alias_out_max = int(props.get("topic_alias_maximum", 0)) \
+            if pkt.proto_ver == C.MQTT_V5 else 0
+
         ack_props = None
         if pkt.proto_ver == C.MQTT_V5:
             ack_props = {
@@ -336,7 +354,6 @@ class Channel:
                 # the broker's own inbound window (zone max_inflight), NOT
                 # the client-RM-capped outbound window
                 "receive_maximum": self.mqtt.get("max_inflight", 32),
-                "maximum_qos": self.mqtt.get("max_qos_allowed", 2),
                 "retain_available": int(self.mqtt.get("retain_available", True)),
                 "maximum_packet_size": self.mqtt.get("max_packet_size"),
                 "topic_alias_maximum": self.mqtt.get("max_topic_alias", 65535),
@@ -346,6 +363,10 @@ class Channel:
                 "shared_subscription_available":
                     int(self.mqtt.get("shared_subscription", True)),
             }
+            # MQTT-3.2.2-9: Maximum-QoS is only sent when the broker caps
+            # below 2 (absence means the full range is supported)
+            if self.mqtt.get("max_qos_allowed", 2) < 2:
+                ack_props["maximum_qos"] = self.mqtt["max_qos_allowed"]
             if server_ka:
                 ack_props["server_keep_alive"] = server_ka
             if self._assigned_clientid:
@@ -436,7 +457,10 @@ class Channel:
         topic = pkt.topic
         # v5 topic alias resolution (emqx_channel packet_to_message)
         props = pkt.properties or {}
-        alias = props.get("topic_alias")
+        alias = props.pop("topic_alias", None) if props else None
+        # the publisher's alias is connection-scoped: it must never leak
+        # into the routed message (a subscriber's alias space is its own —
+        # the reference strips it in packet_to_message the same way)
         if self.proto_ver == C.MQTT_V5 and alias is not None:
             if not (0 < alias <= self.mqtt.get("max_topic_alias", 65535)):
                 return self._disconnect_now(C.RC_TOPIC_ALIAS_INVALID)
@@ -447,10 +471,21 @@ class Channel:
                 if topic is None:
                     return self._disconnect_now(C.RC_PROTOCOL_ERROR,
                                                 "unknown topic alias")
-        if not topic or not T.validate(topic, "name"):
+        try:
+            valid = bool(topic) and T.validate(topic, "name")
+        except T.TopicError:
+            valid = False       # wildcard/too-long/bad-level topic NAME
+        if not valid:
             return self._puberr(pkt, C.RC_TOPIC_NAME_INVALID)
+        if self.proto_ver == C.MQTT_V5 and props.get("response_topic") \
+                and T.wildcard(props["response_topic"]):
+            # MQTT-3.3.2-14: a Response Topic must not contain wildcards
+            return self._disconnect_now(C.RC_PROTOCOL_ERROR,
+                                        "wildcard response topic")
         if pkt.qos > self.mqtt.get("max_qos_allowed", 2):
-            return self._puberr(pkt, C.RC_QOS_NOT_SUPPORTED)
+            # MQTT-3.2.2-11: publishing above the broker's Maximum QoS is
+            # a DISCONNECT-worthy offence, not a per-packet nack
+            return self._disconnect_now(C.RC_QOS_NOT_SUPPORTED)
         if pkt.retain and not self.mqtt.get("retain_available", True):
             return self._puberr(pkt, C.RC_RETAIN_NOT_SUPPORTED)
 
@@ -604,8 +639,11 @@ class Channel:
         if not await self._authorize("subscribe", real):
             self.node.metrics.inc("packets.subscribe.auth_error")
             return C.RC_NOT_AUTHORIZED
-        qos = min(int(popts.get("qos", 0)),
-                  self.mqtt.get("max_qos_allowed", 2))
+        # NOT capped by max_qos_allowed: the reference grants the requested
+        # QoS even under a lower broker cap (emqx_mqtt_protocol_v5_SUITE
+        # t_connack_max_qos_allowed, MQTT-3.2.2-10) — the cap applies to
+        # inbound PUBLISH packets, not to subscription grants
+        qos = int(popts.get("qos", 0))
         popts["qos"] = qos
         if subid is not None:
             popts["subid"] = subid
@@ -731,7 +769,21 @@ class Channel:
     def _to_publish(self, pid: Optional[int], m: Message) -> P.Publish:
         props = dict(m.headers.get("properties") or {}) \
             if self.proto_ver == C.MQTT_V5 else None
-        return P.Publish(topic=self._unmount(m.topic), payload=m.payload,
+        topic = self._unmount(m.topic)
+        # outbound topic aliasing (emqx_channel packing_alias): within the
+        # client's advertised Topic-Alias-Maximum, the first delivery of a
+        # topic carries topic+alias, repeats carry the alias alone; topics
+        # beyond the alias budget go un-aliased
+        if self.alias_out_max and topic:
+            alias = self.alias_out.get(topic)
+            if alias is not None:
+                props["topic_alias"] = alias
+                topic = ""
+            elif len(self.alias_out) < self.alias_out_max:
+                alias = len(self.alias_out) + 1
+                self.alias_out[topic] = alias
+                props["topic_alias"] = alias
+        return P.Publish(topic=topic, payload=m.payload,
                          qos=m.qos, retain=m.retain, dup=m.dup,
                          packet_id=pid or 0, properties=props)
 
@@ -772,6 +824,12 @@ class Channel:
         self._pendings = []
         sess = self.session
         self.session = None     # ownership moved
+        if self.proto_ver == C.MQTT_V5:
+            # MQTT-3.1.4-3: tell the displaced connection why it's going
+            # (the reference's ?RC_SESSION_TAKEN_OVER disconnect on kick,
+            # asserted by emqx_mqtt_protocol_v5_SUITE t_connect_clean_start)
+            self._send([P.Disconnect(
+                reason_code=C.RC_SESSION_TAKEN_OVER)])
         self.node.metrics.inc("session.takenover")
         self.node.hooks.run("session.takenover", (self.clientinfo, sess))
         if self.sid is not None:

@@ -73,8 +73,11 @@ class RedisResource(_PooledDbResource):
                 out = []
                 for s in raw or []:
                     if isinstance(s, str):
-                        host, _, port = s.strip().partition(":")
-                        out.append((host, int(port or 6379)))
+                        # rpartition: IPv6 hosts carry colons of their own
+                        host, sep, port = s.strip().rpartition(":")
+                        if not sep:
+                            host, port = port, ""
+                        out.append((host.strip("[]"), int(port or 6379)))
                     else:
                         out.append((s[0], int(s[1])))
                 return out
