@@ -73,11 +73,25 @@ class RedisResource(_PooledDbResource):
                 out = []
                 for s in raw or []:
                     if isinstance(s, str):
-                        # rpartition: IPv6 hosts carry colons of their own
-                        host, sep, port = s.strip().rpartition(":")
-                        if not sep:
-                            host, port = port, ""
-                        out.append((host.strip("[]"), int(port or 6379)))
+                        # accepted forms: 'host', 'host:port',
+                        # '[v6]' / '[v6]:port', and bare 'v6' (which is
+                        # ambiguous with host:port, so any string with
+                        # 2+ colons outside brackets is taken as a
+                        # port-less IPv6 host)
+                        t = s.strip()
+                        if t.startswith("["):
+                            host, _, port = t.rpartition(":")
+                            if host.endswith("]"):
+                                host = host[1:-1]
+                            else:           # '[v6]' without a port
+                                host, port = t.strip("[]"), ""
+                        elif t.count(":") > 1:
+                            host, port = t, ""
+                        else:
+                            host, sep, port = t.rpartition(":")
+                            if not sep:
+                                host, port = t, ""
+                        out.append((host, int(port or 6379)))
                     else:
                         out.append((s[0], int(s[1])))
                 return out

@@ -1,0 +1,387 @@
+"""MQTT-SN wire-level conformance: the reference's C client case matrix.
+
+A 1:1 port of /root/reference/apps/emqx_gateway/test/intergration_test/
+client/*.c — each test below maps onto the C program of the same name
+(case1..case7: 12 case programs; the 13th file, int_test_result.c, is
+the harness's result reporter, not a case). The C harness runs pub/sub
+pairs as separate processes against a live gateway; here each leg is a
+named test driving the same wire bytes over UDP (the acceptable language
+swap SURVEY.md §2.3 records for this component).
+
+Case matrix (from the C sources' headers):
+  case1  qos0 publish with a SHORT topic name ("tt"), subscriber on the
+         normal name auto-registered at SUBSCRIBE
+  case2  qos0 publish with a PREDEFINED topic id
+  case3  qos0 publish with a NORMAL topic id obtained via REGISTER
+  case4  QoS -1 (qos bits 0b11) publish with a PREDEFINED id, no CONNECT
+  case5  QoS -1 publish with a SHORT topic name, no CONNECT
+  case6  sleeping client: DISCONNECT(duration) handshake, buffered
+         delivery drained by PINGREQ(clientid)
+  case7  double connect: same clientid reconnects, new clientid connects
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+import emqx_tpu.gateway.mqttsn as SN
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+
+
+class Capture:
+    def __init__(self):
+        self.msgs = []
+
+    def deliver(self, tf, msg):
+        self.msgs.append(msg)
+        return True
+
+
+class SnWireClient(asyncio.DatagramProtocol):
+    """Raw-UDP client, byte-for-byte what the C clients send."""
+
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(SN.decode(data))
+
+    @classmethod
+    async def create(cls, port):
+        loop = asyncio.get_running_loop()
+        proto = cls()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: proto, remote_addr=("127.0.0.1", port))
+        proto.transport = transport
+        return proto
+
+    def send(self, msg_type, body=b""):
+        self.transport.sendto(SN.encode(msg_type, body))
+
+    async def recv(self, timeout=5):
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    async def connect(self, clientid=b"testclientid_case1", flags=0):
+        # MQTTSNSerialize_connect: flags, protocol_id=1, duration, clientid
+        self.send(SN.CONNECT, bytes([flags, 1]) +
+                  struct.pack(">H", 60) + clientid)
+        t, body = await self.recv()
+        assert t == SN.CONNACK and body[0] == 0, (t, body)
+
+    async def subscribe_name(self, topicname: bytes, qos=1, mid=2):
+        """SUBSCRIBE by topic NAME (type 0b00); returns the assigned
+        topic id from SUBACK (auto-registration at subscribe)."""
+        self.send(SN.SUBSCRIBE, bytes([qos << 5]) +
+                  struct.pack(">H", mid) + topicname)
+        t, body = await self.recv()
+        assert t == SN.SUBACK and body[-1] == 0, (t, body)
+        return struct.unpack(">H", body[1:3])[0]
+
+    async def subscribe_predef(self, tid: int, qos=1, mid=2):
+        """SUBSCRIBE by PREDEFINED id (topic-type bits 0b01)."""
+        self.send(SN.SUBSCRIBE, bytes([(qos << 5) | 0x01]) +
+                  struct.pack(">H", mid) + struct.pack(">H", tid))
+        t, body = await self.recv()
+        assert t == SN.SUBACK and body[-1] == 0, (t, body)
+
+    def publish_short(self, name: bytes, payload: bytes, qos=0, mid=0):
+        """PUBLISH with a SHORT (2-char) topic, type bits 0b10."""
+        q = 3 if qos == -1 else qos
+        self.send(SN.PUBLISH, bytes([(q << 5) | 0x02]) + name +
+                  struct.pack(">H", mid) + payload)
+
+    def publish_predef(self, tid: int, payload: bytes, qos=0, mid=0):
+        q = 3 if qos == -1 else qos
+        self.send(SN.PUBLISH, bytes([(q << 5) | 0x01]) +
+                  struct.pack(">H", tid) + struct.pack(">H", mid) + payload)
+
+    def publish_normal(self, tid: int, payload: bytes, qos=0, mid=0):
+        self.send(SN.PUBLISH, bytes([qos << 5]) +
+                  struct.pack(">H", tid) + struct.pack(">H", mid) + payload)
+
+    async def register(self, topicname: bytes, mid=1) -> int:
+        self.send(SN.REGISTER, struct.pack(">HH", 0, mid) + topicname)
+        t, body = await self.recv()
+        assert t == SN.REGACK and body[4] == 0, (t, body)
+        return struct.unpack(">H", body[:2])[0]
+
+    async def expect_publish(self, timeout=5):
+        """Collect the next PUBLISH, transparently REGACK-ing any
+        gateway REGISTER (the C read_publish loop does the same)."""
+        while True:
+            t, body = await self.recv(timeout)
+            if t == SN.REGISTER:
+                tid, mid = struct.unpack(">HH", body[:4])
+                self.send(SN.REGACK,
+                          struct.pack(">HH", tid, mid) + b"\x00")
+                continue
+            if t == SN.PUBLISH:
+                flags = body[0]
+                qos = (flags >> 5) & 0x3
+                mid = struct.unpack(">H", body[3:5])[0]
+                if qos == 1:
+                    self.send(SN.PUBACK, body[1:3] +
+                              struct.pack(">H", mid) + b"\x00")
+                return body[5:], flags
+            # ignore anything else (ADVERTISE etc.)
+
+    def close(self):
+        self.transport.close()
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture()
+def sn(loop):
+    node = Node(use_device=False)
+    # predef_topicid 1, exactly the C harness's PRE_DEF_TOPIC_ID
+    gw = SN.MqttSnGateway(node, {"port": 0,
+                                 "predefined": {1: "predef/topic1"}})
+    loop.run_until_complete(gw.start())
+    yield node, gw
+    loop.run_until_complete(gw.stop())
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, 15))
+
+
+class TestCase1ShortTopic:
+    def test_case1_qos0pub(self, loop, sn):
+        """case1_qos0pub.c: qos0 publish with SHORT topic 'tt' routes."""
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "tt")
+            c = await SnWireClient.create(gw.port)
+            await c.connect(b"testclientid_case1pub")
+            c.publish_short(b"tt", b"short-topic qos0")
+            await asyncio.sleep(0.1)
+            assert cap.msgs and cap.msgs[0].payload == b"short-topic qos0"
+            assert cap.msgs[0].topic == "tt" and cap.msgs[0].qos == 0
+            c.close()
+        run(loop, go())
+
+    def test_case1_qos0sub(self, loop, sn):
+        """case1_qos0sub.c: subscribe the normal name 'tt' (registered at
+        SUBSCRIBE), receive the short-topic publish."""
+        node, gw = sn
+
+        async def go():
+            sub = await SnWireClient.create(gw.port)
+            await sub.connect(b"testclientid_case1")
+            await sub.subscribe_name(b"tt", qos=1)
+            pub = await SnWireClient.create(gw.port)
+            await pub.connect(b"testclientid_case1pub")
+            pub.publish_short(b"tt", b"case1 payload")
+            payload, _flags = await sub.expect_publish()
+            assert payload == b"case1 payload"
+            sub.close()
+            pub.close()
+        run(loop, go())
+
+
+class TestCase2Predefined:
+    def test_case2_qos0pub(self, loop, sn):
+        """case2_qos0pub.c: qos0 publish with PREDEFINED topic id 1."""
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "predef/topic1")
+            c = await SnWireClient.create(gw.port)
+            await c.connect(b"testclientid_case2pub")
+            c.publish_predef(1, b"predefined qos0")
+            await asyncio.sleep(0.1)
+            assert cap.msgs and cap.msgs[0].payload == b"predefined qos0"
+            assert cap.msgs[0].topic == "predef/topic1"
+            c.close()
+        run(loop, go())
+
+    def test_case2_qos0sub(self, loop, sn):
+        """case2_qos0sub.c: subscribe by PREDEFINED id, receive."""
+        node, gw = sn
+
+        async def go():
+            sub = await SnWireClient.create(gw.port)
+            await sub.connect(b"testclientid_case2")
+            await sub.subscribe_predef(1, qos=1)
+            pub = await SnWireClient.create(gw.port)
+            await pub.connect(b"testclientid_case2pub")
+            pub.publish_predef(1, b"case2 payload")
+            payload, _flags = await sub.expect_publish()
+            assert payload == b"case2 payload"
+            sub.close()
+            pub.close()
+        run(loop, go())
+
+
+class TestCase3RegisteredTopic:
+    def test_case3_qos0pub(self, loop, sn):
+        """case3_qos0pub.c: REGISTER a normal topic name, publish qos0 by
+        the returned NORMAL topic id."""
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "predef_topic1")
+            c = await SnWireClient.create(gw.port)
+            await c.connect(b"testclientid_case3pub")
+            tid = await c.register(b"predef_topic1")
+            c.publish_normal(tid, b"registered qos0")
+            await asyncio.sleep(0.1)
+            assert cap.msgs and cap.msgs[0].payload == b"registered qos0"
+            assert cap.msgs[0].topic == "predef_topic1"
+            c.close()
+        run(loop, go())
+
+    def test_case3_qos0sub(self, loop, sn):
+        """case3_qos0sub.c: subscriber on the registered name receives
+        the normal-topic-id publish."""
+        node, gw = sn
+
+        async def go():
+            sub = await SnWireClient.create(gw.port)
+            await sub.connect(b"testclientid_case3")
+            await sub.subscribe_name(b"predef_topic1", qos=1)
+            pub = await SnWireClient.create(gw.port)
+            await pub.connect(b"testclientid_case3pub")
+            tid = await pub.register(b"predef_topic1")
+            pub.publish_normal(tid, b"case3 payload")
+            payload, _flags = await sub.expect_publish()
+            assert payload == b"case3 payload"
+            sub.close()
+            pub.close()
+        run(loop, go())
+
+
+class TestCase4QosMinus1Predefined:
+    def test_case4_qos3pub(self, loop, sn):
+        """case4_qos3pub.c: QoS -1 publish with PREDEFINED id 1, NO
+        CONNECT at all — fire and forget."""
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"),
+                                  "predef/topic1")
+            c = await SnWireClient.create(gw.port)
+            c.publish_predef(1, b"qos -1 predefined", qos=-1)
+            await asyncio.sleep(0.1)
+            assert cap.msgs and cap.msgs[0].payload == b"qos -1 predefined"
+            c.close()
+        run(loop, go())
+
+    def test_case4_qos3sub(self, loop, sn):
+        """case4_qos3sub.c: a connected subscriber on the predefined
+        topic receives the connection-less QoS -1 publish."""
+        node, gw = sn
+
+        async def go():
+            sub = await SnWireClient.create(gw.port)
+            await sub.connect(b"testclientid_case4")
+            await sub.subscribe_predef(1, qos=1)
+            pub = await SnWireClient.create(gw.port)
+            pub.publish_predef(1, b"case4 payload", qos=-1)
+            payload, _flags = await sub.expect_publish()
+            assert payload == b"case4 payload"
+            sub.close()
+            pub.close()
+        run(loop, go())
+
+
+class TestCase5QosMinus1Short:
+    def test_case5_qos3pub(self, loop, sn):
+        """case5_qos3pub.c: QoS -1 publish with SHORT topic, no CONNECT."""
+        node, gw = sn
+
+        async def go():
+            cap = Capture()
+            node.broker.subscribe(node.broker.register(cap, "c"), "tt")
+            c = await SnWireClient.create(gw.port)
+            c.publish_short(b"tt", b"qos -1 short", qos=-1)
+            await asyncio.sleep(0.1)
+            assert cap.msgs and cap.msgs[0].payload == b"qos -1 short"
+            c.close()
+        run(loop, go())
+
+    def test_case5_qos3sub(self, loop, sn):
+        """case5_qos3sub.c: subscriber on the short name receives the
+        connection-less QoS -1 publish."""
+        node, gw = sn
+
+        async def go():
+            sub = await SnWireClient.create(gw.port)
+            await sub.connect(b"testclientid_case5")
+            await sub.subscribe_name(b"tt", qos=0)
+            pub = await SnWireClient.create(gw.port)
+            pub.publish_short(b"tt", b"case5 payload", qos=-1)
+            payload, _flags = await sub.expect_publish()
+            assert payload == b"case5 payload"
+            sub.close()
+            pub.close()
+        run(loop, go())
+
+
+class TestCase6Sleep:
+    def test_case6_sleep(self, loop, sn):
+        """case6_sleep.c: DISCONNECT(duration=5) answered with
+        DISCONNECT; messages buffer while asleep; PINGREQ(clientid)
+        drains them and ends with PINGRESP."""
+        node, gw = sn
+
+        async def go():
+            c = await SnWireClient.create(gw.port)
+            await c.connect(b"testclientid_case1")
+            await c.subscribe_name(b"tt", qos=1)
+            # sleep handshake: DISCONNECT with a duration field
+            c.send(SN.DISCONNECT, struct.pack(">H", 5))
+            t, _body = await c.recv()
+            assert t == SN.DISCONNECT
+            # publish while asleep: must buffer, not deliver
+            node.broker.publish(make("m", 1, "tt", b"while asleep"))
+            await asyncio.sleep(0.2)
+            assert c.inbox.empty()
+            # wake: PINGREQ with clientid drains the buffer
+            c.send(SN.PINGREQ, b"testclientid_case1")
+            payload, _flags = await c.expect_publish()
+            assert payload == b"while asleep"
+            t, _body = await c.recv()
+            assert t == SN.PINGRESP
+            c.close()
+        run(loop, go())
+
+
+class TestCase7DoubleConnect:
+    def test_case7_double_connect(self, loop, sn):
+        """case7_double_connect.c: connect clientid A, connect a NEW
+        clientid, reconnect the OLD clientid — each CONNACK accepted."""
+        node, gw = sn
+
+        async def go():
+            c1 = await SnWireClient.create(gw.port)
+            await c1.connect(b"testclientid_case7")
+            c2 = await SnWireClient.create(gw.port)
+            await c2.connect(b"testclientid_case7_new")
+            c3 = await SnWireClient.create(gw.port)
+            await c3.connect(b"testclientid_case7")   # old id again
+            # the reconnected old id is live: it can subscribe + receive
+            await c3.subscribe_name(b"tt", qos=1, mid=9)
+            pub = await SnWireClient.create(gw.port)
+            await pub.connect(b"pub7")
+            pub.publish_short(b"tt", b"after reconnect")
+            payload, _flags = await c3.expect_publish()
+            assert payload == b"after reconnect"
+            for c in (c1, c2, c3, pub):
+                c.close()
+        run(loop, go())
