@@ -43,11 +43,18 @@ filters deliver straight from device rows (packed opts unpacked on the fly),
 no host dict walk. Messages flagged overflow/too-deep fall back to the full
 host path (emqx_router.erl:136-141 short-circuit analog).
 
-Shared subscriptions: device picks (ops.shared cursors) drive delivery when
-the node is standalone and the strategy is device-supported (round_robin /
-random / hash_*); under a cluster (remote members live off-device) or the
-sticky strategy, shared dispatch stays host-side — same split as round 1
-documented, now actually wired.
+Shared subscriptions: device picks (ops.shared cursors) drive delivery for
+every device-supported strategy (round_robin / random / hash_*), clustered
+or not. Under a cluster the snapshot's member list is the CLUSTER-WIDE
+membership (emqx_shared_sub:pick semantics over all nodes' members,
+emqx_shared_sub.erl:239-268): local members carry their subopts, remote
+members ride as reserved-range sids (>= _REMOTE_SID_BASE) that index a
+host-side (origin, remote_sid) list — a remote pick is forwarded with the
+same directed shared.deliver_fwd RPC the host path uses
+(emqx_shared_sub.erl dispatch's cross-node SubPid ! send). Only the sticky
+strategy stays host-side (its pick is feedback-dependent). A remote
+join/leave dirties the slot (store watcher → note_member_change) so the
+group serves host-side until the next rebuild.
 """
 
 from __future__ import annotations
@@ -87,11 +94,17 @@ def _next_pow2(x: int) -> int:
     return 1 << max(2, (x - 1).bit_length())
 
 
+# device member ids at/above this are remote refs: they index the built
+# snapshot's remote_members list instead of a local session row (int32-safe;
+# local sids are small dense ints)
+_REMOTE_SID_BASE = 1 << 30
+
+
 class _Built:
     """One compiled snapshot (host-side indexes of the device tables)."""
 
     __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_of", "slot_key",
-                 "n_slots", "backend")
+                 "n_slots", "backend", "remote_members")
 
     def __init__(self):
         self.fid_of: dict[str, int] = {}
@@ -100,6 +113,9 @@ class _Built:
         self.slot_of: dict[tuple, int] = {}       # (filter, group) -> slot
         self.slot_key: list[tuple] = []           # slot -> (filter, group)
         self.n_slots = 0
+        # remote shared members: device sid _REMOTE_SID_BASE+i -> (origin,
+        # remote_sid); consume forwards picks for these over RPC
+        self.remote_members: list[tuple] = []
         self.backend = "trie"
 
 
@@ -242,14 +258,39 @@ class DeviceRouteEngine:
         result = self._build_from_capture(capture)
         self._apply_build(result, journal=())
 
-    def _group_local(self, real: str, group: str) -> bool:
-        """Standalone: every group is locally homed. Under a cluster only
-        groups with no live remote members keep the on-device pick
-        (round-2 weak #10: config-5-shaped deployments previously lost
-        the whole device P8 path)."""
-        cluster = self.broker.cluster
-        return cluster is None or cluster.group_is_local(self.broker, real,
-                                                         group)
+    def _capture_shared(self, f: str) -> dict:
+        """Per-filter shared-group capture for the snapshot.
+
+        Standalone: the local SharedGroup members with their subopts.
+        Clustered: the CLUSTER-WIDE membership (cluster._members — the
+        same sorted (origin, sid) view the host pick uses), with local
+        members carrying subopts and remote members captured as
+        ((origin, sid), None) refs that the build turns into
+        reserved-range device sids. Remote-only groups known purely via
+        replication are captured too — every device-supported strategy's
+        pick runs on device regardless of where members live."""
+        broker = self.broker
+        cluster = broker.cluster
+        local = broker.shared.get(f) or {}
+        if cluster is None:
+            return {g: (list(grp.members.items()), grp.cursor)
+                    for g, grp in local.items() if grp.members}
+        names = set(local) | cluster._groups_by_real.get(f, set())
+        me = cluster.rpc.node
+        out = {}
+        for g in sorted(names):
+            grp = local.get(g)
+            members = []
+            for origin, sid in cluster._members(broker, f, g):
+                if origin == me:
+                    opts = grp.members.get(sid) if grp else None
+                    if opts is not None:
+                        members.append((sid, opts))
+                else:
+                    members.append(((origin, sid), None))
+            if members:
+                out[g] = (members, grp.cursor if grp else 0)
+        return out
 
     def _capture_state_sync(self):
         """Point-in-time copy of the routing state (sync, may stall)."""
@@ -258,10 +299,11 @@ class DeviceRouteEngine:
         filters = exact + wild
         subs = {f: list(broker.subs[f].items())
                 for f in filters if broker.subs.get(f)}
-        shared = {f: {g: (list(grp.members.items()), grp.cursor)
-                      for g, grp in broker.shared[f].items()
-                      if self._group_local(f, g)}
-                  for f in filters if broker.shared.get(f)}
+        shared = {}
+        for f in filters:
+            cap = self._capture_shared(f)
+            if cap:
+                shared[f] = cap
         return exact, wild, subs, shared
 
     async def _capture_state_async(self, chunk: int = 1024):
@@ -282,11 +324,9 @@ class DeviceRouteEngine:
                 s = broker.subs.get(f)
                 if s:
                     subs[f] = list(s.items())
-                g = broker.shared.get(f)
-                if g:
-                    shared[f] = {gn: (list(grp.members.items()), grp.cursor)
-                                 for gn, grp in g.items()
-                                 if self._group_local(f, gn)}
+                cap = self._capture_shared(f)
+                if cap:
+                    shared[f] = cap
             await asyncio.sleep(0)
         return exact, wild, subs, shared
 
@@ -342,6 +382,13 @@ class DeviceRouteEngine:
                 b.slot_key.append((f, g))
                 members = []
                 for sid, opts in members_raw:
+                    if isinstance(sid, tuple):
+                        # remote member ref: reserve a device sid that
+                        # indexes remote_members; opts live on its node
+                        dev_sid = _REMOTE_SID_BASE + len(b.remote_members)
+                        b.remote_members.append(sid)
+                        members.append((dev_sid, 0))
+                        continue
                     if _is_rich(opts):
                         rich.add(f)
                     members.append((sid, _pack_opts(opts)))
@@ -548,11 +595,11 @@ class DeviceRouteEngine:
 
     # ---- the serving path ----------------------------------------------
     def device_shared_active(self) -> bool:
-        """Device picks serve all device-supported strategies; under a
-        cluster the snapshot holds only locally-homed groups, and groups
-        with remote members dispatch cluster-wide at consume time
-        (round-2 weak #10 — previously ANY cluster disabled the whole
-        on-device shared path)."""
+        """Device picks serve all device-supported strategies, clustered
+        or standalone — the snapshot holds the cluster-wide membership
+        with remote members as forwardable refs (round-4: previously
+        groups with remote members fell back to host dispatch; round-2
+        before that, ANY cluster disabled the whole on-device path)."""
         from emqx_tpu.ops.shared import STRATEGIES
         return self.broker.shared_strategy in STRATEGIES
 
@@ -920,6 +967,11 @@ class DeviceRouteEngine:
             f, gname = b.slot_key[slot]
             g = self.broker.shared.get(f, {}).get(gname)
             if g is not None and g.members:
+                # for mixed local/remote groups this folds the device's
+                # full-membership advance onto the local cursor — an
+                # approximation that keeps the host fallback fair, not a
+                # correctness input (the device cursor itself is
+                # authoritative while the snapshot serves)
                 g.cursor = (g.cursor + int(occur[slot])) % len(g.members)
 
     def _consume_one(self, msg, m_row, r_row, o_row, ss_row, sr_row, so_row,
@@ -974,7 +1026,25 @@ class DeviceRouteEngine:
                         n += 1
                     continue
                 sid = int(sr_row[k])
-                if sid >= 0 and broker._deliver(
+                if sid >= _REMOTE_SID_BASE:
+                    # device picked a remote member: directed forward,
+                    # the host path's cross-node dispatch with the pick
+                    # already done on device
+                    cluster = broker.cluster
+                    if cluster is not None:
+                        origin, rsid = \
+                            b.remote_members[sid - _REMOTE_SID_BASE]
+                        cluster._spawn_fwd(
+                            origin, "shared.deliver_fwd",
+                            [f, gname, rsid, msg.to_wire()],
+                            key=msg.topic)
+                        n += 1
+                        metrics.inc("messages.routed.device")
+                        metrics.inc("messages.routed.device.remote_shared")
+                    elif self._host_shared_dispatch(f, gname, msg):
+                        # cluster torn down since the build: host decides
+                        n += 1
+                elif sid >= 0 and broker._deliver(
                         sid, f, msg,
                         dict(_unpack_opts(int(so_row[k])), share=gname)):
                     n += 1

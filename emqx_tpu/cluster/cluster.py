@@ -292,15 +292,6 @@ class ClusterNode:
                     n += 1
         return n
 
-    def group_is_local(self, broker, real: str, group: str) -> bool:
-        """True when every live replicated member of (real, group) is on
-        this node — such groups can keep the on-device pick path (the
-        device snapshot holds exactly the local members)."""
-        me = self.rpc.node
-        return all(o == me for o, _sid in
-                   self.store.table(T_SHARED).lookup((real, group))
-                   if self.membership.is_running(o))
-
     def _members(self, broker, real: str, group: str) -> list[tuple[str, int]]:
         out = {(o, v) for o, v in
                self.store.table(T_SHARED).lookup((real, group))

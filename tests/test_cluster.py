@@ -553,7 +553,9 @@ async def _test_device_shared_local_groups():
         rc = Capture()
         b1.subscribe(b1.register(rc, "rc"), "$share/loc/work/+")
         await settle(clusters)
-        assert not clusters[0].group_is_local(b0, "work/+", "loc")
+        origins = {o for o, _sid in
+                   clusters[0]._members(b0, "work/+", "loc")}
+        assert origins == {"d0@127.0.0.1", "d1@127.0.0.1"}
         before = len(la.msgs) + len(lb.msgs)
         msgs = [make("p", 0, f"work/x{i}", b"y") for i in range(9)]
         counts = eng.route_batch(msgs)
@@ -561,6 +563,24 @@ async def _test_device_shared_local_groups():
         total = (len(la.msgs) + len(lb.msgs) - before) + len(rc.msgs)
         assert total == 9, "single delivery violated after remote join"
         assert len(rc.msgs) >= 1, "remote member never picked"
+
+        # after a rebuild the MIXED group serves on-device again: the
+        # snapshot holds the cluster-wide membership, remote picks are
+        # forwarded (round-4 extension of the locally-homed split)
+        eng.rebuild()
+        assert not eng.dirty_slots
+        before_l = len(la.msgs) + len(lb.msgs)
+        before_r = len(rc.msgs)
+        msgs = [make("p", 0, f"work/z{i}", b"w") for i in range(9)]
+        counts = eng.route_batch(msgs)
+        await settle(clusters)
+        assert counts == [1] * 9
+        got_l = len(la.msgs) + len(lb.msgs) - before_l
+        got_r = len(rc.msgs) - before_r
+        assert got_l + got_r == 9, "single delivery violated on device"
+        assert got_r >= 1, "device never picked the remote member"
+        assert nodes[0].metrics.val(
+            "messages.routed.device.remote_shared") >= 1
     finally:
         await teardown(clusters)
 

@@ -98,6 +98,60 @@ async def main(cycles: int) -> None:
     anchor = await connect_fast(seed["mqtt"], "anchor")
     await anchor.subscribe([("chaos/#", P.SubOpts(qos=QOS))])
 
+    # shared-group invariant members: one on the seed, one on a node the
+    # chaos will kill/freeze — group dispatch (device picks under
+    # CHAOS_DEVICE=1, incl. remote-member forwards) must stay
+    # exactly-once-per-group at every steady state
+    share1 = await connect_fast(seed["mqtt"], "share-1")
+    await share1.subscribe([("$share/grp/shgrp/t", P.SubOpts(qos=QOS))])
+    share2 = await connect_fast(b["mqtt"], "share-2")
+    await share2.subscribe([("$share/grp/shgrp/t", P.SubOpts(qos=QOS))])
+    shared_epoch = 0
+
+    def drain_shared():
+        got = []
+        for s in (share1, share2):
+            while not s.messages.empty():
+                got.append(s.messages.get_nowait().payload)
+        return got
+
+    async def check_shared(pub_client, bound_s=None):
+        """Invariant 6: a steady-state burst into the share group lands
+        exactly once per message across the members. A settle probe
+        first absorbs the post-heal transition (stale members purge,
+        dirty slots, snapshot rebuild)."""
+        nonlocal shared_epoch
+        shared_epoch += 1
+        bound_s = (bound_s or 8.0) * LAX
+        drain_shared()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < bound_s:     # settle probe
+            await pub_client.publish("shgrp/t", b"probe", qos=QOS,
+                                     timeout=bound_s + 2)
+            await asyncio.sleep(0.15)
+            if b"probe" in drain_shared():
+                break
+        else:
+            raise AssertionError("share group never resumed")
+        mark = f"e{shared_epoch}-".encode()
+        expected = [mark + str(i).encode() for i in range(10)]
+        for p in expected:
+            await pub_client.publish("shgrp/t", p, qos=QOS,
+                                     timeout=bound_s + 2)
+        got: list = []
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < bound_s:
+            got += [p for p in drain_shared() if p.startswith(mark)]
+            if len(got) >= len(expected):
+                break
+            await asyncio.sleep(0.1)
+        # grace drain: a late DUPLICATE must not escape the assertion by
+        # arriving after the count was reached
+        await asyncio.sleep(0.5 * LAX)
+        got += [p for p in drain_shared() if p.startswith(mark)]
+        assert sorted(got) == sorted(expected), \
+            f"shared group: want {len(expected)} exactly-once, got {got}"
+
     seq = 0
     received: set = set()
     dupes: list = []
@@ -171,6 +225,7 @@ async def main(cycles: int) -> None:
     await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
     await publish_burst(pub, 20)
     await wait_resume()
+    await check_shared(pub)
 
     for cycle in range(cycles):
         victim_name = rng.choice(list(others))
@@ -196,6 +251,11 @@ async def main(cycles: int) -> None:
                     extra = await connect_fast(seed["mqtt"], "extra-sub",
                                                bound_s=8.0)
                     await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
+                # share2 is NOT re-homed on freeze: its socket to the
+                # frozen node survives the thaw (deliveries buffer in
+                # the socket), and a same-clientid reconnect would
+                # leave a zombie member behind — the discard RPC times
+                # out against the frozen owner
                 probe = await connect_fast(seed["mqtt"],
                                            f"frz-{cycle}", bound_s=8.0)
                 await probe.disconnect()
@@ -206,6 +266,7 @@ async def main(cycles: int) -> None:
             await wait_members(3)             # thaw: autoheal
             await publish_burst(pub, 10)
             await wait_resume()
+            await check_shared(pub)           # invariant 6 after thaw
             print(f"[cycle {cycle}] thawed, seq={seq}, "
                   f"anchor_received={len(received)}", flush=True)
             continue
@@ -222,6 +283,10 @@ async def main(cycles: int) -> None:
         if extra.port == victim["mqtt"]:
             extra = await connect_fast(seed["mqtt"], "extra-sub")
             await extra.subscribe([("chaos/#", P.SubOpts(qos=1))])
+        if share2.port == victim["mqtt"]:
+            share2 = await connect_fast(seed["mqtt"], "share-2")
+            await share2.subscribe(
+                [("$share/grp/shgrp/t", P.SubOpts(qos=QOS))])
 
         await publish_burst(pub, 10)          # invariant 2 during outage
         await wait_resume()                   # invariant 3
@@ -251,6 +316,7 @@ async def main(cycles: int) -> None:
                 pass
         assert got_back, f"rejoined {victim_name} unreachable (stale peer)"
         await back.disconnect()
+        await check_shared(pub)               # invariant 6 after heal
         print(f"[cycle {cycle}] healed, seq={seq}, "
               f"anchor_received={len(received)}", flush=True)
 
