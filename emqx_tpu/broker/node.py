@@ -52,7 +52,24 @@ class Node:
                                          False))
         self.device_engine = None
         self.publish_batcher = None
-        if use_device:
+        mc = perf.get("multichip") or {}
+        if mc.get("enable"):
+            # multichip serving mode: route through a dp×route device
+            # mesh (parallel.serving) instead of the single-chip engine;
+            # same PublishBatcher protocol, so channels are none the wiser
+            from emqx_tpu.broker.batcher import PublishBatcher
+            from emqx_tpu.parallel.serving import ShardedRouteServer
+            self.device_engine = ShardedRouteServer(
+                self, n_devices=mc.get("devices"), dp=mc.get("dp"),
+                fanout_cap=perf.get("device_fanout_cap", 128),
+                slot_cap=perf.get("device_slot_cap", 16),
+                max_batch=mc.get("max_batch", 256))
+            self.publish_batcher = PublishBatcher(
+                self, self.device_engine,
+                window_us=perf.get("batch_window_us", 200),
+                max_batch=mc.get("max_batch", 256),
+                device_min_batch=perf.get("device_min_batch", 4))
+        elif use_device:
             from emqx_tpu.broker.batcher import PublishBatcher
             from emqx_tpu.broker.device_engine import DeviceRouteEngine
             self.device_engine = DeviceRouteEngine(

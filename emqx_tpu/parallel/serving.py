@@ -1,0 +1,565 @@
+"""Multichip serving: a live node routing through the dp×route mesh.
+
+`ShardedRouteServer` is the multi-device sibling of
+broker.device_engine.DeviceRouteEngine: it compiles the node's live
+routing state into PER-SHARD RouterTables (filters partitioned by
+crc32(filter) % route — the device-mesh analog of the reference's
+`broker_pool` topic-hash serialization, emqx_broker.erl:427-428), serves
+publish batches through parallel.sharded.make_sharded_route_step, and
+consumes the [B, route, ...] RouteResult into real session deliveries.
+It implements the PublishBatcher engine protocol, so a node boots with it
+exactly like the single-chip engine and channels publish through the
+same micro-batch window.
+
+Churn model (simpler than the single-chip engine's dirty-filter +
+delta-trie scheme): a subscription/route change dirties its filter's
+SHARD; the next batch's `poll_rebuild` rebuilds the dirty shards
+host-side with the snapshot's capacity classes and writes only their
+slices into the stacked device arrays (parallel.sharded.update_shard —
+one XLA dynamic_update_index_in_dim per shard, nothing else moves). A
+shard outgrowing its capacity class triggers a full rebuild. Rebuilds
+are synchronous-before-serve, so the device tables are never stale:
+per-filter host fallbacks are unnecessary.
+
+Cluster interplay: normal-route forwarding works exactly as the
+single-chip consume (cluster.forward on the matched set). Shared groups
+ride device slots when standalone; under a cluster the shared dispatch
+stays host-side (cluster-wide pick) — combining mesh serving with
+cross-node shared refs is not wired here.
+
+Reference parity anchors: emqx_broker.erl:199-308 (the per-message path
+this replaces), emqx_router.erl:77-86 (full replication this shards),
+SURVEY.md §2.4 P2/P4/P6.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from emqx_tpu.broker.device_engine import (_is_rich, _next_pow2,
+                                           _pack_opts, _unpack_opts)
+from emqx_tpu.broker.message import Message
+from emqx_tpu.ops import intern as I
+from emqx_tpu.utils import topic as T
+
+
+class _ShardBuilt:
+    """Host index of one shard's compiled tables."""
+
+    __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_key", "rich",
+                 "host_extra")
+
+    def __init__(self):
+        self.fid_of: dict[str, int] = {}
+        self.fid_filter: list[str] = []
+        self.seg_len: list[int] = []
+        self.slot_key: list[tuple] = []      # local slot -> (filter, group)
+        self.rich: set[str] = set()          # host-dict dispatch filters
+        self.host_extra: list[tuple] = []    # too-deep: (filter, words)
+
+
+class _Handle:
+    """One dispatched batch (PublishBatcher handle protocol).
+
+    Pins the FULL snapshot it was prepared against — host index AND
+    device tables/cursors — so a shard update applied while this batch
+    is in the pipeline can neither re-index its decode nor swap the
+    arrays under its dispatch (the batch serves the snapshot it saw,
+    exactly like the single-chip engine's in-flight batches)."""
+
+    __slots__ = ("subs", "built", "tables", "cursors", "enc", "res",
+                 "np_res", "t0", "host_idx")
+
+    def __init__(self, subs, built, tables, cursors, enc, host_idx):
+        self.subs = subs          # [[Message, ...]] — W=1: one sub-batch
+        self.built = built        # list[_ShardBuilt] snapshot
+        self.tables = tables      # stacked device pytree at prepare time
+        self.cursors = cursors
+        self.enc = enc
+        self.host_idx = host_idx  # msg indexes forced host-side (too_long)
+        self.res = None
+        self.np_res = None
+        self.t0: Optional[float] = None
+
+
+class ShardedRouteServer:
+    """Serve a node's publishes through an n-device (dp×route) mesh."""
+
+    def __init__(self, node, *, n_devices: Optional[int] = None,
+                 dp: Optional[int] = None, mesh=None,
+                 frontier_cap: int = 16, match_cap: int = 64,
+                 fanout_cap: int = 128, slot_cap: int = 16,
+                 level_cap: int = 16, max_batch: int = 256):
+        from emqx_tpu.parallel.mesh import make_mesh
+        self.node = node
+        self.broker = node.broker
+        self.router = node.broker.router
+        if mesh is None:
+            import jax
+            n_devices = n_devices or len(jax.devices())
+            mesh = make_mesh(n_devices, dp=dp)
+        self.mesh = mesh
+        self.n_route = mesh.shape["route"]
+        self.n_dp = mesh.shape["dp"]
+        self.frontier_cap = frontier_cap
+        self.match_cap = match_cap
+        self.fanout_cap = fanout_cap
+        self.slot_cap = slot_cap
+        self.level_cap = level_cap
+        self.max_batch = max_batch
+        self._STD_CLASSES = ((1, max_batch),)
+
+        from emqx_tpu.parallel.sharded import make_sharded_route_step
+        self.step = make_sharded_route_step(
+            mesh, backend="trie", frontier_cap=frontier_cap,
+            match_cap=match_cap, fanout_cap=fanout_cap, slot_cap=slot_cap)
+
+        self.intern = I.InternTable()
+        self.tables = None            # stacked device pytree [R, ...]
+        self.cursors = None           # device [R, G_cap]
+        self._builts: Optional[list[_ShardBuilt]] = None
+        self._caps: Optional[dict] = None
+        self.dirty_shards: set[int] = set()
+        self._warm_classes: set[int] = set()
+        self._warm_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()   # dispatch thread vs loop rebuilds
+
+        # engine wiring (same hooks DeviceRouteEngine claims)
+        self.broker.device_engine = self
+        node.device_engine = self
+        self.router.on_route_change = self.note_route_change
+
+    # ---- churn tracking -------------------------------------------------
+    def shard_of(self, topic_filter: str) -> int:
+        return zlib.crc32(topic_filter.encode()) % self.n_route
+
+    def note_route_change(self, topic_filter: str, added: bool) -> None:
+        self.dirty_shards.add(self.shard_of(topic_filter))
+
+    def note_member_change(self, real: str, group) -> None:
+        self.dirty_shards.add(self.shard_of(real))
+
+    # ---- build ----------------------------------------------------------
+    def _capture_shard(self, s: int, filters: list[str]):
+        """(filters, subs, shared) for shard s — local members only (see
+        module docstring for the cluster split)."""
+        broker = self.broker
+        mine = [f for f in filters if self.shard_of(f) == s]
+        subs = {f: list(broker.subs[f].items())
+                for f in mine if broker.subs.get(f)}
+        shared = {}
+        if broker.cluster is None:
+            for f in mine:
+                g = broker.shared.get(f)
+                if g:
+                    shared[f] = {gn: (list(grp.members.items()), grp.cursor)
+                                 for gn, grp in g.items() if grp.members}
+        return mine, subs, shared
+
+    def _shard_dims(self, capture) -> dict:
+        """Raw (un-padded) dims one shard's capture needs."""
+        mine, subs, shared = capture
+        n_slots = sum(len(g) for g in shared.values())
+        return {
+            "filters": len(mine),
+            "nodes": sum(len(T.tokens(f)) for f in mine) + 1,
+            "subs": sum(len(v) for v in subs.values()),
+            "slots": n_slots,
+            "members": sum(len(m[0]) for g in shared.values()
+                           for m in g.values()),
+        }
+
+    @staticmethod
+    def _caps_of(dims: dict) -> dict:
+        return {k: _next_pow2(max(2, v)) for k, v in dims.items()}
+
+    @staticmethod
+    def _fits(dims: dict, caps: dict) -> bool:
+        return all(dims[k] <= caps[k] for k in dims)
+
+    def _build_shard(self, capture, caps: dict):
+        """Compile one shard's capture into (built, RouterTables host,
+        cursors row) with the given capacity classes."""
+        from emqx_tpu.models.router_engine import RouterTables
+        from emqx_tpu.ops.fanout import build_subtable
+        from emqx_tpu.ops.trie import build_tables
+
+        mine, subs_cap, shared_cap = capture
+        b = _ShardBuilt()
+        L = self.level_cap
+        # filters deeper than the level cap can't ride the device trie:
+        # they match host-side per message (rare; SURVEY §5.7's too-deep
+        # fallback)
+        deep = [f for f in mine if len(T.tokens(f)) > L]
+        for f in deep:
+            b.host_extra.append((f, T.tokens(f)))
+        mine = [f for f in mine if len(T.tokens(f)) <= L]
+        rows = np.full((len(mine), L), I.PAD, np.int32)
+        lens = np.zeros(len(mine), np.int32)
+        normal: dict[int, list] = {}
+        filter_slots: dict[int, list] = {}
+        shared_members: dict[int, list] = {}
+        seg_len = [0] * len(mine)
+        cursors = []
+        for fid, f in enumerate(sorted(mine)):
+            ws = T.tokens(f)
+            ids = self.intern.encode_filter(ws)
+            rows[fid, :len(ids)] = ids
+            lens[fid] = len(ids)
+            b.fid_of[f] = fid
+            b.fid_filter.append(f)
+            entries = []
+            for sid, opts in subs_cap.get(f, ()):
+                # rich subopts (v5 subids etc.) don't survive the packed
+                # byte: keep the device rows for alignment but deliver
+                # through the host dict (same split as the single-chip
+                # engine's rich_filters)
+                if _is_rich(opts):
+                    b.rich.add(f)
+                entries.append((sid, _pack_opts(opts)))
+            if entries:
+                normal[fid] = entries
+                seg_len[fid] = len(entries)
+            for gname in sorted(shared_cap.get(f, {})):
+                members_raw, cursor = shared_cap[f][gname]
+                slot = len(b.slot_key)
+                b.slot_key.append((f, gname))
+                shared_members[slot] = [(sid, _pack_opts(o))
+                                        for sid, o in members_raw]
+                filter_slots.setdefault(fid, []).append(slot)
+                cursors.append(cursor)
+        b.seg_len = seg_len
+
+        trie = build_tables(rows[:len(mine)], lens,
+                            node_capacity=caps["nodes"],
+                            slot_capacity=4 * caps["nodes"])
+        subs_tbl = build_subtable(
+            caps["filters"], {k: v for k, v in normal.items()},
+            filter_slots, shared_members,
+            slot_cap=caps["slots"], sub_rows_cap=caps["subs"],
+            fs_rows_cap=caps["slots"], member_rows_cap=caps["members"])
+        cur = np.zeros(caps["slots"], np.int32)
+        cur[:len(cursors)] = cursors
+        return b, RouterTables(trie=trie, subs=subs_tbl), cur
+
+    def rebuild(self) -> None:
+        """Full build: capture every shard, compute shared capacity
+        classes, compile, stack, place on the mesh."""
+        from emqx_tpu.parallel.sharded import put_sharded, stack_tables
+        filters = list(self.router.exact) + list(self.router.wildcards)
+        captures = [self._capture_shard(s, filters)
+                    for s in range(self.n_route)]
+        dims = [self._shard_dims(c) for c in captures]
+        caps = self._caps_of({k: max(d[k] for d in dims)
+                              for k in dims[0]})
+        builts, tables, cursors = [], [], []
+        for c in captures:
+            b, t, cur = self._build_shard(c, caps)
+            builts.append(b)
+            tables.append(t)
+            cursors.append(cur)
+        stacked = stack_tables(tables)
+        dev_tables, dev_cursors = put_sharded(
+            self.mesh, stacked, np.stack(cursors))
+        with self._lock:
+            self.tables = dev_tables
+            self.cursors = dev_cursors
+            self._builts = builts
+            if caps != self._caps:
+                # capacity classes are the jit signature: only a class
+                # change invalidates compiled batch classes — clearing
+                # on every rebuild kept the device permanently cold
+                # under subscribe churn
+                self._warm_classes.clear()
+            self._caps = caps
+            self.dirty_shards.clear()
+
+    def poll_rebuild(self) -> None:
+        """Apply pending churn BEFORE serving: rebuild each dirty shard
+        with the snapshot's capacities and update only its device slice;
+        grow → full rebuild. Synchronous, so served tables are never
+        stale."""
+        if self._builts is None:
+            self.rebuild()
+            return
+        if not self.dirty_shards:
+            return
+        from emqx_tpu.parallel.sharded import update_shard
+        filters = list(self.router.exact) + list(self.router.wildcards)
+        pending = sorted(self.dirty_shards)
+        for s in pending:
+            capture = self._capture_shard(s, filters)
+            if not self._fits(self._shard_dims(capture), self._caps):
+                self.rebuild()
+                return
+            b, t, cur = self._build_shard(capture, self._caps)
+            with self._lock:
+                self.tables = update_shard(self.tables, s, t)
+                cur_np = np.array(self.cursors)     # copy: jax buffers
+                cur_np[s] = cur                     # are read-only
+                import jax
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                self.cursors = jax.device_put(
+                    cur_np, NamedSharding(self.mesh, P("route")))
+                # copy-on-write: in-flight handles keep decoding with the
+                # list they captured (their tables snapshot predates this
+                # update), and the dispatch-side `_builts is h.built`
+                # cursor guard must FIRE for them now
+                builts = list(self._builts)
+                builts[s] = b
+                self._builts = builts
+                self.dirty_shards.discard(s)
+
+    # ---- PublishBatcher engine protocol ---------------------------------
+    def _batch_class(self, n: int) -> int:
+        return min(self.max_batch,
+                   max(self.n_dp, _next_pow2(max(2, n))))
+
+    def batch_class_warm(self, n_msgs: int) -> bool:
+        return self._builts is not None and \
+            self._batch_class(n_msgs) in self._warm_classes
+
+    def _kick_class_warm(self) -> None:
+        """Compile the standard batch classes off the serving path."""
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            return
+        if self._builts is None:
+            return
+
+        def warm():
+            # loop until every class is warm for the CURRENT capacity
+            # signature: a caps-changing rebuild mid-loop clears earlier
+            # classes, and a single ascending pass would never revisit
+            # them (observed: only the last class warm, device cold)
+            classes = []
+            Bp = self.n_dp
+            while Bp <= self.max_batch:
+                classes.append(Bp)
+                Bp *= 2
+            for _ in range(8 * len(classes)):   # bounded self-heal
+                missing = [c for c in classes
+                           if c not in self._warm_classes]
+                if not missing or self._builts is None:
+                    return
+                self._warm_one(missing[0])
+
+        self._warm_thread = threading.Thread(target=warm, daemon=True)
+        self._warm_thread.start()
+
+    def _warm_one(self, Bp: int) -> None:
+        import jax
+        from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+        enc = (np.full((Bp, self.level_cap), I.PAD, np.int32),
+               np.zeros(Bp, np.int32), np.zeros(Bp, bool),
+               np.zeros(Bp, np.int32))
+        with self._lock:
+            tables, cursors, caps = self.tables, self.cursors, self._caps
+        res = self.step(tables, cursors, *enc,
+                        np.int32(STRATEGY_ROUND_ROBIN))
+        jax.block_until_ready(res)
+        with self._lock:
+            if self._caps == caps:      # signature still current
+                self._warm_classes.add(Bp)
+
+    def max_fuse(self) -> int:
+        return 1        # no window fusion on the mesh path (yet)
+
+    def abandon(self, h: _Handle) -> None:
+        h.res = None
+        h.np_res = None
+
+    def prepare(self, msgs: list[Message]) -> Optional[_Handle]:
+        return self.prepare_window([msgs])
+
+    def prepare_window(self, lives) -> Optional[_Handle]:
+        """Stage 1 (event loop): encode one micro-batch (W=1)."""
+        self.poll_rebuild()
+        if self._builts is None or not lives:
+            return None
+        from emqx_tpu.ops.match import encode_topics
+        msgs = lives[0]
+        Bp = self._batch_class(len(msgs))
+        if len(msgs) > Bp:
+            return None
+        words = [T.words(m.topic) for m in msgs]
+        enc, lens, dollar, too_long = encode_topics(
+            self.intern, words, self.level_cap)
+        host_idx = set(np.flatnonzero(too_long).tolist())
+        pad = Bp - len(msgs)
+        if pad:
+            enc = np.vstack([enc, np.full((pad, self.level_cap), I.PAD,
+                                          np.int32)])
+            lens = np.concatenate([lens, np.zeros(pad, np.int32)])
+            dollar = np.concatenate([dollar, np.zeros(pad, bool)])
+        msg_hash = np.array(
+            [zlib.crc32(m.topic.encode()) & 0x7FFFFFFF for m in msgs]
+            + [0] * pad, np.int32)
+        with self._lock:
+            return _Handle(subs=[msgs], built=self._builts,
+                           tables=self.tables, cursors=self.cursors,
+                           enc=(enc, lens, dollar, msg_hash),
+                           host_idx=host_idx)
+
+    def dispatch(self, h: _Handle) -> None:
+        """Stage 2 (executor thread): run the mesh step on the handle's
+        pinned snapshot; adopt cursors unless an update raced (then the
+        freshly written cursor row wins — a one-batch fairness blip, not
+        a correctness input). The batcher serializes dispatches on one
+        thread, so cursor threading across batches is ordered."""
+        from emqx_tpu.ops.shared import STRATEGIES
+        strategy = STRATEGIES.get(self.broker.shared_strategy, 0)
+        with self._lock:
+            # live cursors when no update raced (pipelined batches chain
+            # round-robin state); the pinned ones otherwise — they are
+            # the only set consistent with h.tables' slot layout
+            cursors = self.cursors if self._builts is h.built \
+                else h.cursors
+        h.res = self.step(h.tables, cursors, *h.enc, np.int32(strategy))
+        with self._lock:
+            if self._builts is h.built:    # no rebuild raced us
+                self.cursors = h.res.new_cursors
+
+    def materialize(self, h: _Handle) -> None:
+        """Stage 3 (executor thread): device → host readbacks."""
+        r = h.res
+        h.np_res = {
+            "matches": np.asarray(r.matches),
+            "rows": np.asarray(r.rows), "opts": np.asarray(r.opts),
+            "shared_sids": np.asarray(r.shared_sids),
+            "shared_rows": np.asarray(r.shared_rows),
+            "shared_opts": np.asarray(r.shared_opts),
+            "overflow": np.asarray(r.overflow),
+            "occur": np.asarray(r.occur),      # [R, G]
+        }
+
+    def finish_sub(self, h: _Handle, k: int) -> list[int]:
+        """Stage 4 (event loop): consume into deliveries (W=1: k==0)."""
+        msgs = h.subs[k]
+        np_res = h.np_res
+        counts = []
+        for i, msg in enumerate(msgs):
+            if i in h.host_idx or bool(np_res["overflow"][i].any()):
+                counts.append(self._host_route(msg))
+                continue
+            counts.append(self._consume_one(msg, i, np_res, h.built))
+        self._writeback_cursors(np_res["occur"], h.built)
+        return counts
+
+    def _writeback_cursors(self, occur, builts) -> None:
+        """Mirror device round-robin advances onto the host
+        SharedGroup.cursor — the next shard capture re-seeds the device
+        row from it, so without this every churn event would reset the
+        group's rotation (the single-chip engine's _sync_cursors)."""
+        if self.broker.shared_strategy != "round_robin":
+            return
+        for r in range(self.n_route):
+            b = builts[r]
+            occ = occur[r]
+            for slot in np.flatnonzero(occ[:len(b.slot_key)]):
+                f, gname = b.slot_key[slot]
+                g = self.broker.shared.get(f, {}).get(gname)
+                if g is not None and g.members:
+                    g.cursor = (g.cursor + int(occ[slot])) \
+                        % len(g.members)
+
+    def finish(self, h: _Handle) -> list[int]:
+        return self.finish_sub(h, 0)
+
+    # ---- consume --------------------------------------------------------
+    def _host_route(self, msg: Message) -> int:
+        broker = self.broker
+        return broker._route(msg, broker.router.match(msg.topic))
+
+    def _consume_one(self, msg, i: int, np_res, builts) -> int:
+        broker = self.broker
+        metrics = self.node.metrics
+        dev_shared = broker.cluster is None and \
+            self.broker.shared_strategy in self._dev_strategies()
+        n = 0
+        matched: list[str] = []
+        for r in range(self.n_route):
+            b = builts[r]
+            off = 0
+            row_m = np_res["matches"][i, r]
+            rows = np_res["rows"][i, r]
+            opts = np_res["opts"][i, r]
+            # fan-out rows are the concatenation of per-filter segments
+            # in LOCAL fid order of the matched set
+            for fid in row_m:
+                if fid < 0:
+                    continue
+                f = b.fid_filter[fid]
+                matched.append(f)
+                seg = b.seg_len[fid]
+                if f in b.rich:      # rich-subopts filter: host dict
+                    n += broker.dispatch(f, msg)
+                else:
+                    for j in range(off, off + seg):
+                        sid = int(rows[j])
+                        if sid >= 0 and broker._deliver(
+                                sid, f, msg, _unpack_opts(int(opts[j]))):
+                            n += 1
+                            metrics.inc("messages.routed.device")
+                off += seg
+            # too-deep filters: host match per message (rare); string
+            # form so the $-topic exclusion rule applies
+            for f, _fws in b.host_extra:
+                if T.match(msg.topic, f):
+                    matched.append(f)
+                    n += broker.dispatch(f, msg)
+            if dev_shared:
+                srow = np_res["shared_sids"][i, r]
+                prow = np_res["shared_rows"][i, r]
+                orow = np_res["shared_opts"][i, r]
+                for k, slot in enumerate(srow):
+                    if slot < 0 or slot >= len(b.slot_key):
+                        continue
+                    f, gname = b.slot_key[slot]
+                    sid = int(prow[k])
+                    if sid >= 0 and broker._deliver(
+                            sid, f, msg,
+                            dict(_unpack_opts(int(orow[k])), share=gname)):
+                        n += 1
+                        metrics.inc("messages.routed.device")
+        if not dev_shared:
+            n += broker._dispatch_shared(msg, matched)
+        if broker.cluster:
+            n += broker.cluster.forward(msg, matched)
+        if n == 0 and not msg.is_sys:
+            metrics.inc("messages.dropped")
+            metrics.inc("messages.dropped.no_subscribers")
+            broker.hooks.run("message.dropped", (msg, "no_subscribers"))
+        return n
+
+    @staticmethod
+    def _dev_strategies():
+        from emqx_tpu.ops.shared import STRATEGIES
+        return STRATEGIES
+
+    # ---- synchronous composition (publish_batch / tests / bench) --------
+    def route_batch(self, msgs: list[Message]) -> Optional[list[int]]:
+        h = self.prepare(msgs)
+        if h is None:
+            return None
+        h.t0 = time.perf_counter()
+        self.dispatch(h)
+        self.materialize(h)
+        return self.finish(h)
+
+    def stats(self) -> dict:
+        return {
+            "built": self._builts is not None,
+            "mesh": {"dp": self.n_dp, "route": self.n_route},
+            "filters": sum(len(b.fid_filter) for b in self._builts or ()),
+            "shared_slots": sum(len(b.slot_key)
+                                for b in self._builts or ()),
+            "dirty_shards": sorted(self.dirty_shards),
+            "caps": dict(self._caps or {}),
+            "warm_classes": sorted(self._warm_classes),
+        }
