@@ -111,8 +111,10 @@ class ShardedRouteServer:
         self.fanout_cap = fanout_cap
         self.slot_cap = slot_cap
         self.level_cap = level_cap
-        self.max_batch = max_batch
-        self._STD_CLASSES = ((1, max_batch),)
+        # pow2: _batch_class quantizes onto the doubling warm ladder — a
+        # non-pow2 cap would name a class the ladder never compiles
+        self.max_batch = _next_pow2(max_batch)
+        self._STD_CLASSES = ((1, self.max_batch),)
 
         from emqx_tpu.parallel.sharded import make_sharded_route_step
         self.step = make_sharded_route_step(
@@ -127,6 +129,7 @@ class ShardedRouteServer:
         self.dirty_shards: set[int] = set()
         self._warm_classes: set[int] = set()
         self._warm_thread: Optional[threading.Thread] = None
+        self._rebuild_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()   # dispatch thread vs loop rebuilds
 
         # engine wiring (same hooks DeviceRouteEngine claims)
@@ -145,11 +148,19 @@ class ShardedRouteServer:
         self.dirty_shards.add(self.shard_of(real))
 
     # ---- build ----------------------------------------------------------
-    def _capture_shard(self, s: int, filters: list[str]):
-        """(filters, subs, shared) for shard s — local members only (see
-        module docstring for the cluster split)."""
+    def _bucket_filters(self) -> list[list[str]]:
+        """One pass over the filter universe → per-shard lists (crc32
+        once per filter, not once per filter per shard)."""
+        buckets: list[list[str]] = [[] for _ in range(self.n_route)]
+        for f in list(self.router.exact) + list(self.router.wildcards):
+            buckets[self.shard_of(f)].append(f)
+        return buckets
+
+    def _capture_shard(self, mine: list[str]):
+        """(filters, subs, shared) for one shard's bucketed filter list —
+        local members only (see module docstring for the cluster
+        split)."""
         broker = self.broker
-        mine = [f for f in filters if self.shard_of(f) == s]
         subs = {f: list(broker.subs[f].items())
                 for f in mine if broker.subs.get(f)}
         shared = {}
@@ -248,12 +259,20 @@ class ShardedRouteServer:
         return b, RouterTables(trie=trie, subs=subs_tbl), cur
 
     def rebuild(self) -> None:
-        """Full build: capture every shard, compute shared capacity
-        classes, compile, stack, place on the mesh."""
+        """Full build, synchronously: capture every shard, compute shared
+        capacity classes, compile, stack, place on the mesh. Direct
+        callers (tests, boot warm-up) use this; the SERVING path never
+        does — poll_rebuild hands full rebuilds to a background thread
+        and serves host-side meanwhile."""
+        seen = set(self.dirty_shards)
+        self._adopt_full_build(self._full_build(
+            [self._capture_shard(mine)
+             for mine in self._bucket_filters()]), seen)
+
+    def _full_build(self, captures):
+        """Compile every shard from its capture (loop-free: thread-safe
+        off the event loop)."""
         from emqx_tpu.parallel.sharded import put_sharded, stack_tables
-        filters = list(self.router.exact) + list(self.router.wildcards)
-        captures = [self._capture_shard(s, filters)
-                    for s in range(self.n_route)]
         dims = [self._shard_dims(c) for c in captures]
         caps = self._caps_of({k: max(d[k] for d in dims)
                               for k in dims[0]})
@@ -266,6 +285,10 @@ class ShardedRouteServer:
         stacked = stack_tables(tables)
         dev_tables, dev_cursors = put_sharded(
             self.mesh, stacked, np.stack(cursors))
+        return caps, builts, dev_tables, dev_cursors
+
+    def _adopt_full_build(self, result, seen: set) -> None:
+        caps, builts, dev_tables, dev_cursors = result
         with self._lock:
             self.tables = dev_tables
             self.cursors = dev_cursors
@@ -277,29 +300,56 @@ class ShardedRouteServer:
                 # under subscribe churn
                 self._warm_classes.clear()
             self._caps = caps
-            self.dirty_shards.clear()
+            # churn that landed AFTER the capture stays dirty and gets a
+            # per-shard update on the next poll
+            self.dirty_shards -= seen
 
-    def poll_rebuild(self) -> None:
-        """Apply pending churn BEFORE serving: rebuild each dirty shard
-        with the snapshot's capacities and update only its device slice;
-        grow → full rebuild. Synchronous, so served tables are never
-        stale."""
+    def _kick_full_rebuild(self) -> None:
+        """Background full rebuild: CAPTURE on the caller (event-loop)
+        side for a consistent host-state snapshot, COMPILE + UPLOAD on a
+        thread. Serving stays host-side until the swap (prepare_window
+        returns None while this runs) — the single-chip engine's
+        double-buffered rebuild, mesh edition."""
+        if self._rebuild_thread is not None \
+                and self._rebuild_thread.is_alive():
+            return
+        seen = set(self.dirty_shards)
+        captures = [self._capture_shard(mine)
+                    for mine in self._bucket_filters()]
+
+        def work():
+            self._adopt_full_build(self._full_build(captures), seen)
+
+        self._rebuild_thread = threading.Thread(target=work, daemon=True)
+        self._rebuild_thread.start()
+
+    def poll_rebuild(self) -> bool:
+        """Apply pending churn BEFORE serving. Dirty shards rebuild
+        host-side with the snapshot's capacities and only their device
+        slices update (non-donating: in-flight handles still read the
+        previous arrays); outgrowing a class kicks a BACKGROUND full
+        rebuild. Returns False while the mesh cannot serve (no snapshot
+        yet / full rebuild in progress) — callers route host-side."""
+        if self._rebuild_thread is not None \
+                and self._rebuild_thread.is_alive():
+            return False
         if self._builts is None:
-            self.rebuild()
-            return
+            self._kick_full_rebuild()
+            return False
         if not self.dirty_shards:
-            return
+            return True
         from emqx_tpu.parallel.sharded import update_shard
-        filters = list(self.router.exact) + list(self.router.wildcards)
+        buckets = self._bucket_filters()
         pending = sorted(self.dirty_shards)
         for s in pending:
-            capture = self._capture_shard(s, filters)
+            capture = self._capture_shard(buckets[s])
             if not self._fits(self._shard_dims(capture), self._caps):
-                self.rebuild()
-                return
+                self._kick_full_rebuild()
+                return False
             b, t, cur = self._build_shard(capture, self._caps)
             with self._lock:
-                self.tables = update_shard(self.tables, s, t)
+                self.tables = update_shard(self.tables, s, t,
+                                           donate=False)
                 cur_np = np.array(self.cursors)     # copy: jax buffers
                 cur_np[s] = cur                     # are read-only
                 import jax
@@ -315,6 +365,7 @@ class ShardedRouteServer:
                 builts[s] = b
                 self._builts = builts
                 self.dirty_shards.discard(s)
+        return True
 
     # ---- PublishBatcher engine protocol ---------------------------------
     def _batch_class(self, n: int) -> int:
@@ -379,8 +430,7 @@ class ShardedRouteServer:
 
     def prepare_window(self, lives) -> Optional[_Handle]:
         """Stage 1 (event loop): encode one micro-batch (W=1)."""
-        self.poll_rebuild()
-        if self._builts is None or not lives:
+        if not self.poll_rebuild() or self._builts is None or not lives:
             return None
         from emqx_tpu.ops.match import encode_topics
         msgs = lives[0]
@@ -543,7 +593,24 @@ class ShardedRouteServer:
         return STRATEGIES
 
     # ---- synchronous composition (publish_batch / tests / bench) --------
-    def route_batch(self, msgs: list[Message]) -> Optional[list[int]]:
+    def route_batch(self, msgs: list[Message],
+                    wait: bool = False) -> Optional[list[int]]:
+        """Route one batch synchronously. Returns None when the mesh
+        cannot serve right now (first build / background rebuild in
+        flight) — callers fall back to the host path. wait=True blocks
+        until the mesh CAN serve (tests, dryrun, boot warm-up: never the
+        event loop)."""
+        if wait:
+            t = self._rebuild_thread
+            if t is not None and t.is_alive():
+                t.join()
+            if self._builts is None:
+                self.rebuild()
+            if not self.poll_rebuild():     # churn kicked a bg rebuild
+                t = self._rebuild_thread
+                if t is not None:
+                    t.join()
+                self.poll_rebuild()
         h = self.prepare(msgs)
         if h is None:
             return None

@@ -59,17 +59,31 @@ def _apply_shard_update(full, new, idx):
         full, new)
 
 
-def update_shard(tables_stacked, shard_idx: int, shard_tables):
+@jax.jit
+def _apply_shard_update_keep(full, new, idx):
+    """Non-donating variant: the PREVIOUS stacked tables stay valid —
+    required when in-flight consumers (pipelined serving handles, a warm
+    thread) still hold the old pytree. Costs a transient second copy of
+    the updated arrays."""
+    return jax.tree.map(
+        lambda f, n: jax.lax.dynamic_update_index_in_dim(f, n, idx, 0),
+        full, new)
+
+
+def update_shard(tables_stacked, shard_idx: int, shard_tables,
+                 donate: bool = True):
     """Incremental churn path (SURVEY §7 hard-part 1 under the mesh):
     subscription changes in ONE filter shard rebuild that shard host-side
     (same capacities as its siblings) and re-put ONLY its slice — the
     round-1 story (rebuild one shard -> restack -> re-upload everything)
     is gone.
 
-    tables_stacked: device pytree with leading 'route' axis (donated!).
+    tables_stacked: device pytree with leading 'route' axis (donated
+    unless donate=False — pass False whenever anything else may still
+    read the old arrays).
     shard_tables: the ONE shard's host pytree (no leading axis).
-    Returns the updated stacked pytree; the caller must adopt it (the
-    donated input is invalid afterwards).
+    Returns the updated stacked pytree; the caller must adopt it (with
+    donate=True the donated input is invalid afterwards).
     """
     n_shards = jax.tree.leaves(tables_stacked)[0].shape[0]
     if not 0 <= shard_idx < n_shards:
@@ -83,8 +97,8 @@ def update_shard(tables_stacked, shard_idx: int, shard_tables):
         raise ValueError(
             "shard tables shapes diverge from the stacked capacity "
             "classes; rebuild every shard with matching capacities")
-    return _apply_shard_update(tables_stacked, shard_tables,
-                               jnp.int32(shard_idx))
+    apply = _apply_shard_update if donate else _apply_shard_update_keep
+    return apply(tables_stacked, shard_tables, jnp.int32(shard_idx))
 
 
 def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",

@@ -71,7 +71,7 @@ def test_route_batch_matches_host_oracle(mc_node):
     topics = (["ora/exact/%d" % i for i in range(8)]
               + ["ora/1/w2", "ora/zzz/w3", "q/deep/r/x", "nomatch/t"])
     msgs = [make("p", 0, t, b"x") for t in topics]
-    counts = eng.route_batch(msgs)
+    counts = eng.route_batch(msgs, wait=True)
     expect = [len(broker.router.match(t)) for t in topics]
     assert counts == expect, (counts, expect)
     # every shard owns at least one filter (hash-spread sanity)
@@ -93,14 +93,14 @@ def test_churn_updates_single_shard_and_serves(mc_node):
     broker.subscribe(sid, "churn/+/t")
     assert eng.dirty_shards     # churn tracked
     msgs = [make("p", 0, "churn/9/t", b"x")]
-    counts = eng.route_batch(msgs)      # poll_rebuild applies the update
+    counts = eng.route_batch(msgs, wait=True)      # poll_rebuild applies the update
     assert counts == [1]
     assert not eng.dirty_shards
     assert cap.msgs and cap.msgs[0].topic == "churn/9/t"
 
     broker.unsubscribe(sid, "churn/+/t")
     assert eng.dirty_shards
-    counts = eng.route_batch([make("p", 0, "churn/9/t", b"y")])
+    counts = eng.route_batch(wait=True, msgs=[make("p", 0, "churn/9/t", b"y")])
     assert counts == [0]
 
 
@@ -114,7 +114,7 @@ def test_shared_group_picks_on_mesh(mc_node):
     broker.subscribe(broker.register(a, "sha"), "$share/g/mesh/work")
     broker.subscribe(broker.register(b, "shb"), "$share/g/mesh/work")
     msgs = [make("p", 0, "mesh/work", b"%d" % i) for i in range(8)]
-    counts = eng.route_batch(msgs)
+    counts = eng.route_batch(msgs, wait=True)
     assert counts == [1] * 8
     assert len(a.msgs) + len(b.msgs) == 8
     assert len(a.msgs) == 4 and len(b.msgs) == 4    # fair round robin
@@ -130,7 +130,7 @@ def test_round_robin_cursor_survives_shard_churn(mc_node):
     a, b = Capture(), Capture()
     broker.subscribe(broker.register(a, "cs-a"), "$share/cg/curs/t")
     broker.subscribe(broker.register(b, "cs-b"), "$share/cg/curs/t")
-    assert eng.route_batch([make("p", 0, "curs/t", b"0")]) == [1]
+    assert eng.route_batch(wait=True, msgs=[make("p", 0, "curs/t", b"0")]) == [1]
     assert len(a.msgs) + len(b.msgs) == 1
     # churn a filter into the SAME shard → that shard rebuilds
     s = eng.shard_of("curs/t")
@@ -139,7 +139,7 @@ def test_round_robin_cursor_survives_shard_churn(mc_node):
         i += 1
     broker.subscribe(broker.register(Capture(), "cs-fill"), f"cfill/{i}")
     assert s in eng.dirty_shards
-    assert eng.route_batch([make("p", 0, "curs/t", b"1")]) == [1]
+    assert eng.route_batch(wait=True, msgs=[make("p", 0, "curs/t", b"1")]) == [1]
     # rotation continued: each member has exactly one
     assert len(a.msgs) == 1 and len(b.msgs) == 1, (len(a.msgs),
                                                    len(b.msgs))
@@ -189,6 +189,26 @@ def test_serves_over_real_sockets_via_batcher(loop):
         node.device_engine.stats()
 
 
+def test_pinned_handle_survives_shard_update(mc_node):
+    """A handle prepared BEFORE a per-shard update must still dispatch:
+    update_shard on the serving path is non-donating, so the old stacked
+    arrays stay alive for in-flight pipelined batches."""
+    node = mc_node
+    broker = node.broker
+    eng = node.device_engine
+    a = Capture()
+    broker.subscribe(broker.register(a, "race-a"), "race/+")
+    eng.route_batch([], wait=True)
+    h = eng.prepare([make("p", 0, "race/1", b"x")])
+    assert h is not None
+    broker.subscribe(broker.register(Capture(), "race-b"), "race2/+")
+    assert eng.poll_rebuild()          # shard update applies in place
+    eng.dispatch(h)                    # old arrays must still be valid
+    eng.materialize(h)
+    assert eng.finish(h) == [1]
+    assert a.msgs and a.msgs[0].topic == "race/1"
+
+
 def test_too_deep_filter_host_fallback(mc_node):
     node = mc_node
     broker = node.broker
@@ -196,7 +216,7 @@ def test_too_deep_filter_host_fallback(mc_node):
     deep = "/".join(["l%d" % i for i in range(20)])   # > level_cap
     cap = Capture()
     broker.subscribe(broker.register(cap, "deep-c"), deep)
-    counts = eng.route_batch([make("p", 0, deep, b"x")])
+    counts = eng.route_batch(wait=True, msgs=[make("p", 0, deep, b"x")])
     assert counts == [1]
     assert cap.msgs and cap.msgs[0].payload == b"x"
 
@@ -213,7 +233,7 @@ def test_capacity_growth_triggers_full_rebuild(mc_node):
         c = Capture()
         caps.append(c)
         broker.subscribe(broker.register(c, "grow%d" % i), "grow/all")
-    counts = eng.route_batch([make("p", 0, "grow/all", b"x")])
+    counts = eng.route_batch(wait=True, msgs=[make("p", 0, "grow/all", b"x")])
     assert counts == [64]
     assert sum(len(c.msgs) for c in caps) == 64
     assert eng._caps["subs"] >= caps_before.get("subs", 0)
