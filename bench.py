@@ -636,6 +636,158 @@ def run_baseline_configs(B: int, window: int) -> dict:
     return out
 
 
+def run_config5(n_routes: int, n_retained: int) -> dict:
+    """BASELINE config 5: 2-node cluster route-sync + retainer replay
+    burst, host-side (no chip involved — this measures the replication
+    and retained-message planes the reference implements with replicated
+    mnesia, emqx_router.erl:251-303 / emqx_retainer_mnesia.erl:49-55).
+
+    Reported rows:
+      route_sync_per_s   bulk route-add convergence rate onto the peer
+      route_sync_p50/p99_ms   single route add → visible-on-peer latency
+      replay_per_s       retained replay burst rate to a late subscriber
+    Scales via BENCH_C5_ROUTES / BENCH_C5_RETAINED (defaults 50k / 20k —
+    the 10M-sub shape's control-plane cost per route is scale-linear,
+    so the rate extrapolates; running 10M route adds through a bench
+    window would measure patience, not design).
+    """
+    import asyncio
+
+    async def go():
+        from emqx_tpu.apps.retainer import Retainer
+        from emqx_tpu.broker.connection import Listener
+        from emqx_tpu.broker.node import Node
+        from emqx_tpu.client import Client
+        from emqx_tpu.cluster import ClusterNode
+        from emqx_tpu.cluster.cluster import T_ROUTE
+
+        nodes, clusters = [], []
+        for i in range(2):
+            node = Node(use_device=False, name=f"b{i}@127.0.0.1")
+            cn = ClusterNode(node, port=0, heartbeat_s=0.5)
+            await cn.start()
+            nodes.append(node)
+            clusters.append(cn)
+        await clusters[1].join(*clusters[0].address)
+        out = {}
+        try:
+            b0 = nodes[0].broker
+            tab1 = clusters[1].store.table(T_ROUTE)
+
+            # --- bulk route-sync: n_routes wildcard filters on node 0,
+            # measure convergence onto node 1's replicated table
+            class Sink:
+                def deliver(self, tf, msg):
+                    return True
+
+            sink = Sink()
+            sid = b0.register(sink, "c5-sink")
+            base = tab1.count()
+            t0 = time.perf_counter()
+            for i in range(n_routes):
+                b0.subscribe(sid, f"c5/d{i}/+/t/#")
+                if i % 2048 == 2047:
+                    await asyncio.sleep(0)
+            await clusters[0].flush()
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                if tab1.count() - base >= n_routes:
+                    break
+                await asyncio.sleep(0.01)
+            dt = time.perf_counter() - t0
+            synced = tab1.count() - base
+            out["route_sync"] = {
+                "routes": int(synced),
+                "per_s": round(synced / dt),
+                "wall_s": round(dt, 2),
+            }
+            log(f"config5 route-sync: {synced} routes -> peer in "
+                f"{dt:.2f}s ({synced / dt / 1e3:.1f}k/s)")
+
+            # --- single-add propagation latency (the visible tail an
+            # individual SUBSCRIBE pays before cluster-wide matching)
+            lats = []
+            lost = 0
+            for i in range(100):
+                f = f"c5lat/{i}/+"
+                t1 = time.perf_counter()
+                b0.subscribe(sid, f)
+                await clusters[0].flush()
+                # bounded per-add: one lost replication event must not
+                # spin this loop into the section watchdog and discard
+                # the rows already measured
+                lim = t1 + 5.0
+                while not tab1.lookup(f):
+                    if time.perf_counter() > lim:
+                        lost += 1
+                        break
+                    await asyncio.sleep(0)
+                else:
+                    lats.append(time.perf_counter() - t1)
+            lats.sort()
+            if lats:
+                out["route_sync_p50_ms"] = round(
+                    lats[len(lats) // 2] * 1000, 2)
+                out["route_sync_p99_ms"] = round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.99))] * 1000, 2)
+            if lost:
+                out["route_sync_lost"] = lost
+            log(f"config5 single-add: p50 {out['route_sync_p50_ms']}ms "
+                f"p99 {out['route_sync_p99_ms']}ms")
+
+            # --- retainer replay burst: n_retained retained messages,
+            # then a late wildcard subscriber over a REAL socket replays
+            # them all
+            ret = nodes[0].register_app(Retainer(nodes[0]).load())
+            lst = Listener(nodes[0], bind="127.0.0.1", port=0)
+            await lst.start()
+            pub = Client(port=lst.port, clientid="c5-pub")
+            await pub.connect()
+            for i in range(n_retained):
+                await pub.publish(f"c5r/{i % 64}/k{i}", b"retained-%d" % i,
+                                  qos=0, retain=True)
+                if i % 512 == 511:
+                    await asyncio.sleep(0)
+            # settle: retained table write-behind
+            for _ in range(600):
+                if len(ret.storage) >= n_retained:
+                    break
+                await asyncio.sleep(0.05)
+            sub = Client(port=lst.port, clientid="c5-sub")
+            await sub.connect()
+            t2 = time.perf_counter()
+            await sub.subscribe("c5r/#", qos=0, timeout=60)
+            got = 0
+            deadline = time.perf_counter() + 120
+            while got < n_retained and time.perf_counter() < deadline:
+                try:
+                    await sub.recv(timeout=5)
+                    got += 1
+                except asyncio.TimeoutError:
+                    break
+            dt2 = time.perf_counter() - t2
+            out["retainer_replay"] = {
+                "retained": int(got),
+                "per_s": round(got / dt2) if dt2 > 0 else 0,
+                "wall_s": round(dt2, 2),
+            }
+            log(f"config5 replay: {got}/{n_retained} retained in "
+                f"{dt2:.2f}s ({got / max(dt2, 1e-9) / 1e3:.1f}k/s)")
+            await pub.disconnect()
+            await sub.disconnect()
+            await lst.stop()
+        finally:
+            for cn in clusters:
+                try:
+                    await cn.stop()
+                except Exception:   # noqa: BLE001 — teardown best-effort
+                    pass
+        return out
+
+    return asyncio.run(go())
+
+
 def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             msgs_per_pub: int, use_device: bool) -> dict:
     """End-to-end PUBLISH→deliver over real TCP sockets.
@@ -893,6 +1045,25 @@ def main():
                     log(f"config suite failed: {type(e).__name__}: {e}")
                     result["configs_error"] = \
                         f"{type(e).__name__}: {str(e)[:160]}"
+                finally:
+                    signal.alarm(0)
+            if os.environ.get("BENCH_CONFIG5", "1") != "0":
+                def _c5_alarm(signum, frame):
+                    raise TimeoutError("config5 watchdog")
+
+                signal.signal(signal.SIGALRM, _c5_alarm)
+                try:
+                    signal.alarm(int(os.environ.get(
+                        "BENCH_C5_TIMEOUT_S", 600)))
+                    result["config5"] = run_config5(
+                        int(os.environ.get("BENCH_C5_ROUTES", 50_000)),
+                        int(os.environ.get("BENCH_C5_RETAINED", 20_000)))
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    signal.alarm(0)
+                    log(f"config5 failed: {type(e).__name__}: {e}")
+                    traceback.print_exc(file=sys.stderr)
+                    result["config5_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
                 finally:
                     signal.alarm(0)
             if os.environ.get("BENCH_E2E", "1") != "0":

@@ -16,10 +16,14 @@ delta-trie scheme): a subscription/route change dirties its filter's
 SHARD; the next batch's `poll_rebuild` rebuilds the dirty shards
 host-side with the snapshot's capacity classes and writes only their
 slices into the stacked device arrays (parallel.sharded.update_shard —
-one XLA dynamic_update_index_in_dim per shard, nothing else moves). A
-shard outgrowing its capacity class triggers a full rebuild. Rebuilds
-are synchronous-before-serve, so the device tables are never stale:
-per-filter host fallbacks are unnecessary.
+one XLA dynamic_update_index_in_dim per shard, nothing else moves;
+non-donating, so pipelined in-flight batches keep their pinned arrays).
+Per-shard updates are synchronous-before-serve. A shard OUTGROWING its
+capacity class kicks a BACKGROUND full rebuild (capture on the event
+loop, compile+upload on a thread): while it runs, poll_rebuild returns
+False and every batch routes host-side — correct, never stale, just
+slower — until the swap; churn landing after the capture stays dirty
+and follows as per-shard updates.
 
 Cluster interplay: normal-route forwarding works exactly as the
 single-chip consume (cluster.forward on the matched set). Shared groups
@@ -130,6 +134,7 @@ class ShardedRouteServer:
         self._warm_classes: set[int] = set()
         self._warm_thread: Optional[threading.Thread] = None
         self._rebuild_thread: Optional[threading.Thread] = None
+        self._rebuild_backoff_until = 0.0
         self._lock = threading.Lock()   # dispatch thread vs loop rebuilds
 
         # engine wiring (same hooks DeviceRouteEngine claims)
@@ -264,10 +269,10 @@ class ShardedRouteServer:
         callers (tests, boot warm-up) use this; the SERVING path never
         does — poll_rebuild hands full rebuilds to a background thread
         and serves host-side meanwhile."""
-        seen = set(self.dirty_shards)
+        self.dirty_shards.clear()   # the capture below covers everything
         self._adopt_full_build(self._full_build(
             [self._capture_shard(mine)
-             for mine in self._bucket_filters()]), seen)
+             for mine in self._bucket_filters()]))
 
     def _full_build(self, captures):
         """Compile every shard from its capture (loop-free: thread-safe
@@ -287,7 +292,7 @@ class ShardedRouteServer:
             self.mesh, stacked, np.stack(cursors))
         return caps, builts, dev_tables, dev_cursors
 
-    def _adopt_full_build(self, result, seen: set) -> None:
+    def _adopt_full_build(self, result) -> None:
         caps, builts, dev_tables, dev_cursors = result
         with self._lock:
             self.tables = dev_tables
@@ -300,25 +305,42 @@ class ShardedRouteServer:
                 # under subscribe churn
                 self._warm_classes.clear()
             self._caps = caps
-            # churn that landed AFTER the capture stays dirty and gets a
-            # per-shard update on the next poll
-            self.dirty_shards -= seen
 
     def _kick_full_rebuild(self) -> None:
         """Background full rebuild: CAPTURE on the caller (event-loop)
         side for a consistent host-state snapshot, COMPILE + UPLOAD on a
         thread. Serving stays host-side until the swap (prepare_window
         returns None while this runs) — the single-chip engine's
-        double-buffered rebuild, mesh edition."""
+        double-buffered rebuild, mesh edition.
+
+        Dirty marks for the captured shards clear AT CAPTURE TIME: churn
+        landing while the compile runs re-dirties its shard and follows
+        as a per-shard update after the swap (clearing at adopt time
+        would silently discard it). A failed build restores the marks
+        and backs off before the next attempt — a persistent compile
+        error must not become a tight respawn loop."""
         if self._rebuild_thread is not None \
                 and self._rebuild_thread.is_alive():
+            return
+        if time.monotonic() < self._rebuild_backoff_until:
             return
         seen = set(self.dirty_shards)
         captures = [self._capture_shard(mine)
                     for mine in self._bucket_filters()]
+        self.dirty_shards -= seen
 
         def work():
-            self._adopt_full_build(self._full_build(captures), seen)
+            try:
+                result = self._full_build(captures)
+            except Exception:   # noqa: BLE001 — surfaced + retried
+                import logging
+                logging.getLogger("emqx_tpu.serving").exception(
+                    "background mesh rebuild failed; backing off")
+                self.node.metrics.inc("routing.mesh.rebuild_failed")
+                self.dirty_shards |= seen
+                self._rebuild_backoff_until = time.monotonic() + 5.0
+                return
+            self._adopt_full_build(result)
 
         self._rebuild_thread = threading.Thread(target=work, daemon=True)
         self._rebuild_thread.start()
