@@ -731,10 +731,12 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
                 out["route_sync_p99_ms"] = round(
                     lats[min(len(lats) - 1,
                              int(len(lats) * 0.99))] * 1000, 2)
+                log(f"config5 single-add: "
+                    f"p50 {out['route_sync_p50_ms']}ms "
+                    f"p99 {out['route_sync_p99_ms']}ms")
             if lost:
                 out["route_sync_lost"] = lost
-            log(f"config5 single-add: p50 {out['route_sync_p50_ms']}ms "
-                f"p99 {out['route_sync_p99_ms']}ms")
+                log(f"config5 single-add: {lost} adds never replicated")
 
             # --- retainer replay burst: n_retained retained messages,
             # then a late wildcard subscriber over a REAL socket replays

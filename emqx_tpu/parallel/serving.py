@@ -269,10 +269,17 @@ class ShardedRouteServer:
         callers (tests, boot warm-up) use this; the SERVING path never
         does — poll_rebuild hands full rebuilds to a background thread
         and serves host-side meanwhile."""
+        seen = set(self.dirty_shards)
         self.dirty_shards.clear()   # the capture below covers everything
-        self._adopt_full_build(self._full_build(
-            [self._capture_shard(mine)
-             for mine in self._bucket_filters()]))
+        try:
+            self._adopt_full_build(self._full_build(
+                [self._capture_shard(mine)
+                 for mine in self._bucket_filters()]))
+        except Exception:
+            # a failed build must not eat the churn marks: the old
+            # snapshot keeps serving and those shards still need repair
+            self.dirty_shards |= seen
+            raise
 
     def _full_build(self, captures):
         """Compile every shard from its capture (loop-free: thread-safe
