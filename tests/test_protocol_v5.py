@@ -569,6 +569,42 @@ class TestSubscribe:
         run(loop, go())
 
 
+class TestFlowControl:
+    def test_receive_maximum_flow_control(self, loop, broker):
+        """MQTT-3.3.4-9 flow control (the conformance property behind the
+        reference's receive-maximum cases): the broker must never exceed
+        the client's advertised Receive Maximum of unacknowledged QoS1
+        deliveries; acking one frees exactly one more."""
+        _node, lst = broker
+
+        async def go():
+            c = await v5(lst.port, "rm-flow",
+                         properties={"receive_maximum": 3})
+            c.auto_ack = False      # hold PUBACKs: the window must cap
+            await c.subscribe(TOPICS[0], qos=1)
+            pub = await v5(lst.port, "rm-pub")
+            for i in range(10):
+                await pub.publish(TOPICS[0], b"m%d" % i, qos=1)
+            got = await receive_messages(c, 10, timeout=1.0)
+            assert len(got) == 3, f"window breached: {len(got)}"
+            # ack one → exactly one more arrives
+            c._send(P.Puback(packet_id=got[0].packet_id))
+            more = await receive_messages(c, 10, timeout=1.0)
+            assert len(more) == 1, f"expected 1 freed slot, got {len(more)}"
+            # ack everything → the rest drains
+            total = len(got) + len(more)
+            pending = got[1:] + more
+            while pending:
+                for m in pending:
+                    c._send(P.Puback(packet_id=m.packet_id))
+                pending = await receive_messages(c, 10, timeout=1.0)
+                total += len(pending)
+            assert total == 10
+            await c.disconnect()
+            await pub.disconnect()
+        run(loop, go())
+
+
 class TestUnsubscribe:
     def test_unscbsctibe(self, loop, broker):
         """t_unscbsctibe (sic): MQTT-3.10.4-4/-5/-6, MQTT-3.11.3-1/-2 —
