@@ -104,7 +104,8 @@ class _Built:
     """One compiled snapshot (host-side indexes of the device tables)."""
 
     __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_of", "slot_key",
-                 "n_slots", "backend", "remote_members")
+                 "n_slots", "backend", "remote_members", "seg_np",
+                 "fid_shared", "fid_rich")
 
     def __init__(self):
         self.fid_of: dict[str, int] = {}
@@ -117,6 +118,10 @@ class _Built:
         # remote_sid); consume forwards picks for these over RPC
         self.remote_members: list[tuple] = []
         self.backend = "trie"
+        # vectorized-consume companions (set once at build):
+        self.seg_np = np.zeros(0, np.int64)       # seg_len as an array
+        self.fid_shared = np.zeros(0, bool)       # fid has shared groups
+        self.fid_rich = np.zeros(0, bool)         # fid has rich subopts
 
 
 class _Handle:
@@ -397,6 +402,13 @@ class DeviceRouteEngine:
                 cursors0.append(cursor)
         b.seg_len = seg_len
         b.n_slots = len(b.slot_key)
+        b.seg_np = np.asarray(seg_len, np.int64)
+        b.fid_shared = np.zeros(max(1, n), bool)
+        for fid in filter_slots:
+            b.fid_shared[fid] = True
+        b.fid_rich = np.zeros(max(1, n), bool)
+        for f in rich:
+            b.fid_rich[b.fid_of[f]] = True
 
         # pow2 capacity classes: recompile only when a class grows
         filter_cap = _next_pow2(n)
@@ -877,7 +889,17 @@ class DeviceRouteEngine:
 
     def finish_sub(self, h, k: int) -> list[int]:
         """Stage 4 (event loop): consume sub-batch k of the window into
-        deliveries. Releases one handle reference."""
+        deliveries. Releases one handle reference.
+
+        The clean common case — local node, no delta/dirty filters, no
+        shared involvement for the message — is consumed by ONE
+        vectorized pre-pass over the whole sub-batch
+        (_consume_batch_fast): the per-message Python walk over
+        match/fan-out rows used to cost more than the entire host route
+        (24ms vs 22ms per 1024-batch at 50k filters), which made the
+        device unable to win e2e no matter how fast the chip was.
+        Messages the fast path can't prove clean fall through to
+        _consume_one unchanged."""
         try:
             (matches, rows, opts, shared_sids, shared_rows, shared_opts,
              overflow, occur) = h.np_res
@@ -886,9 +908,15 @@ class DeviceRouteEngine:
             if h.dev_shared and b.n_slots:
                 self._writeback_cursors(occur[k], b)
             metrics = self.node.metrics
-            counts: list[int] = []
             broker = self.broker
+            fast = self._consume_batch_fast(
+                msgs, matches[k], rows[k], opts[k], shared_sids[k],
+                too_long, overflow[k], h.dev_shared, b)
+            counts: list[int] = []
             for i, msg in enumerate(msgs):
+                if fast[i] is not None:
+                    counts.append(fast[i])
+                    continue
                 if too_long[i] or overflow[k][i]:
                     metrics.inc("routing.device.host_fallback")
                     counts.append(broker._route(
@@ -902,6 +930,93 @@ class DeviceRouteEngine:
             return counts
         finally:
             self._release_one(h)
+
+    def _consume_batch_fast(self, msgs, m_k, r_k, o_k, ss_k, too_long,
+                            overflow_k, dev_shared: bool, b):
+        """Vectorized consume for provably-clean messages. Returns a list
+        with per-message delivery counts, or None where the slow path
+        must run. Clean requires, globally: standalone node (no cluster
+        forward / cluster group sweep), no delta filters, no
+        post-snapshot shared groups; per message: no too-long/overflow,
+        no dirty/rich matched filter, and no shared involvement (no
+        device slot matched; no matched filter with host shared
+        groups)."""
+        broker = self.broker
+        if (broker.cluster is not None or self._delta_filter
+                or self.new_slots_by_filter):
+            return [None] * len(msgs)
+        B = len(msgs)
+        mask = m_k[:B] >= 0
+        mi = np.nonzero(mask)[0]
+        fids = m_k[:B][mask]
+
+        # per-fid host-side mask: rich is snapshot-constant (precomputed
+        # at build); only the usually-empty dirty set costs per-batch work
+        hostside = b.fid_rich
+        if self.dirty_filters:
+            hostside = hostside.copy()
+            for f in self.dirty_filters:
+                fid = b.fid_of.get(f)
+                if fid is not None:
+                    hostside[fid] = True
+
+        slow = np.asarray(too_long[:B]) | (overflow_k[:B] != 0)
+        if fids.size:
+            np.logical_or.at(slow, mi, hostside[fids] | b.fid_shared[fids])
+        if dev_shared:
+            slow |= (ss_k[:B] >= 0).any(axis=1)
+
+        out: list = [None] * B
+        fast_ok = ~slow
+        if not fast_ok.any():
+            return out
+        keep = fast_ok[mi]
+        mi_f, fids_f = mi[keep], fids[keep]
+        seg = b.seg_np[fids_f]
+        total = int(seg.sum())
+        counts = np.zeros(B, np.int64)
+        delivered = 0
+        if total:
+            # row attribution: within each message the fan-out rows are
+            # the concatenation of per-filter segments in match order
+            csum = np.cumsum(seg) - seg            # global exclusive
+            starts = np.flatnonzero(np.r_[True, mi_f[1:] != mi_f[:-1]])
+            base = np.repeat(csum[starts], np.diff(np.r_[starts,
+                                                         mi_f.size]))
+            within = csum - base                   # offset inside msg
+            row_msg = np.repeat(mi_f, seg)
+            ar = np.arange(total)
+            row_local = ar - np.repeat(csum, seg)
+            col = np.repeat(within, seg) + row_local
+            row_fid = np.repeat(fids_f, seg)
+            sid = r_k[row_msg, col]
+            opt = o_k[row_msg, col]
+            valid = sid >= 0
+            fid_filter = b.fid_filter
+            deliver = broker._deliver
+            opt_cache: dict[int, dict] = {}
+            for bi, s, ob, fd in zip(row_msg[valid].tolist(),
+                                     sid[valid].tolist(),
+                                     opt[valid].tolist(),
+                                     row_fid[valid].tolist()):
+                so = opt_cache.get(ob)
+                if so is None:
+                    so = opt_cache[ob] = _unpack_opts(ob)
+                if deliver(s, fid_filter[fd], msgs[bi], dict(so)):
+                    counts[bi] += 1
+                    delivered += 1
+        if delivered:
+            self.node.metrics.inc("messages.routed.device", delivered)
+        metrics = self.node.metrics
+        hooks = broker.hooks
+        for i in np.flatnonzero(fast_ok).tolist():
+            n = int(counts[i])
+            if n == 0 and not msgs[i].is_sys:
+                metrics.inc("messages.dropped")
+                metrics.inc("messages.dropped.no_subscribers")
+                hooks.run("message.dropped", (msgs[i], "no_subscribers"))
+            out[i] = n
+        return out
 
     def finish(self, h) -> list[int]:
         """Stage 4 for single-batch callers (route_batch): window of 1."""
