@@ -170,7 +170,6 @@ class DeviceRouteEngine:
         self.dirty_filters: set[str] = set()
         self.dirty_slots: set[tuple] = set()
         self.new_slots_by_filter: dict[str, set[str]] = {}
-        self.rich_filters: set[str] = set()
         from emqx_tpu.ops.trie import HostTrie
         self._delta_trie = HostTrie()
         self._delta_filter: dict[int, str] = {}
@@ -453,11 +452,10 @@ class DeviceRouteEngine:
             self._cursors = None
             self._cur_sig = ()
         else:
-            b, tables, cursors, rich = result
+            b, tables, cursors, _rich = result
             self._built = b
             self._tables = tables
             self._cursors = cursors
-            self.rich_filters = rich
             self._cur_sig = self._tables_sig(tables) \
                 if b.backend == "shapes" else ()
             # evict warmth of superseded signatures (unbounded set
@@ -1104,7 +1102,10 @@ class DeviceRouteEngine:
             f = b.fid_filter[fid]
             seg = b.seg_len[fid]
             matched.append(f)
-            if f in self.dirty_filters or f in self.rich_filters:
+            # rich-ness is snapshot state: read it from the handle's
+            # pinned _Built (fid_rich), never from engine-level state —
+            # one source of truth shared with the vectorized fast path
+            if f in self.dirty_filters or b.fid_rich[fid]:
                 n += broker.dispatch(f, msg)
             else:
                 for k in range(off, off + seg):

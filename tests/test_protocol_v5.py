@@ -4,8 +4,9 @@ A 1:1 port of the reference's full-conformance suite
 /root/reference/apps/emqx/test/emqx_mqtt_protocol_v5_SUITE.erl — every
 test name below maps onto the t_* case of the same name (the reference's
 typos `assigned_clienid` / `unscbsctibe` are preserved so the mapping is
-greppable). Cases drive a live broker over real TCP sockets with the
-bundled client, exactly as the reference drives emqx with emqtt.
+greppable). Cases drive a live broker with the bundled client over BOTH
+transports the reference's groups/1 runs — tcp and quic — exactly as the
+reference drives emqx with emqtt / emqtt-quic.
 
 The one commented-out reference case (t_connect_will_delay_interval,
 marked "REFACTOR NEED" upstream) is ported as a working test of the same
@@ -33,13 +34,65 @@ def loop():
     loop.close()
 
 
-@pytest.fixture()
-def broker(loop):
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    from emqx_tpu.utils.tls import generate_self_signed
+    return generate_self_signed(str(tmp_path_factory.mktemp("v5-certs")))
+
+
+def _make_transport(loop, node, transport, certs):
+    """Start a tcp or quic listener on `node`; return (mk, cleanup) where
+    mk(clientid, **kw) builds an UNCONNECTED client wired for that
+    transport — the reference suite's {tcp, quic} groups over one case
+    list (emqx_mqtt_protocol_v5_SUITE groups/1)."""
+    if transport == "tcp":
+        lst = Listener(node, bind="127.0.0.1", port=0)
+        loop.run_until_complete(lst.start())
+
+        def mk(clientid="", **kw):
+            return Client(port=lst.port, clientid=clientid,
+                          proto_ver=C.MQTT_V5, **kw)
+
+        def cleanup():
+            loop.run_until_complete(lst.stop())
+        return mk, cleanup
+
+    from emqx_tpu.quic import QuicClientConnection, QuicListener
+    lst = QuicListener(node, bind="127.0.0.1", port=0,
+                       certfile=certs["certfile"],
+                       keyfile=certs["keyfile"])
+    loop.run_until_complete(lst.start())
+    qcs: list = []
+
+    def mk(clientid="", **kw):
+        async def factory():
+            qc = QuicClientConnection(port=lst.port,
+                                      cafile=certs["cacertfile"])
+            await qc.connect()
+            qcs.append(qc)
+            return qc.open_stream()
+        return Client(clientid=clientid, proto_ver=C.MQTT_V5,
+                      conn_factory=factory, **kw)
+
+    def cleanup():
+        for qc in qcs:
+            try:
+                qc.close(0, "test end", app=True)
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        loop.run_until_complete(lst.stop())
+    return mk, cleanup
+
+
+@pytest.fixture(params=["tcp", "quic"])
+def broker(loop, request, certs):
+    """A live node reachable over the parametrized transport — every
+    case below runs twice, exactly like the reference's tcp/quic
+    groups."""
     node = Node()
-    listener = Listener(node, bind="127.0.0.1", port=0)
-    loop.run_until_complete(listener.start())
-    yield node, listener
-    loop.run_until_complete(listener.stop())
+    mk, cleanup = _make_transport(loop, node, request.param, certs)
+    yield node, mk
+    cleanup()
 
 
 def run(loop, coro, timeout=20):
@@ -47,14 +100,20 @@ def run(loop, coro, timeout=20):
 
 
 def make_broker(loop, config):
+    """Config-variant cases (fresh node, TCP): the zone knobs under test
+    are transport-independent. Returns (node, listener, mk)."""
     node = Node(config)
     listener = Listener(node, bind="127.0.0.1", port=0)
     loop.run_until_complete(listener.start())
-    return node, listener
+
+    def mk(clientid="", **kw):
+        return Client(port=listener.port, clientid=clientid,
+                      proto_ver=C.MQTT_V5, **kw)
+    return node, listener, mk
 
 
-async def v5(port, clientid="", **kw) -> Client:
-    c = Client(port=port, clientid=clientid, proto_ver=C.MQTT_V5, **kw)
+async def v5(mk, clientid="", **kw) -> Client:
+    c = mk(clientid, **kw)
     await c.connect()
     return c
 
@@ -81,10 +140,10 @@ class TestBasic:
     def test_basic_test(self, loop, broker):
         """t_basic_test: subscribe qos1 then qos2, 3 qos2 publishes, 3
         deliveries."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "basic")
+            c = await v5(mk, "basic")
             assert (await c.subscribe(TOPICS[0], qos=1)).reason_codes == [1]
             assert (await c.subscribe(TOPICS[0], qos=2)).reason_codes == [2]
             for _ in range(3):
@@ -98,19 +157,19 @@ class TestConnection:
     def test_connect_clean_start(self, loop, broker):
         """t_connect_clean_start: MQTT-3.1.2-4/-5/-6 session-present
         semantics + DISCONNECT 0x8E (142) to the displaced connection."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "t_connect_clean_start",
+            c1 = await v5(mk, "t_connect_clean_start",
                           clean_start=True)
             assert c1.connack.session_present is False   # [MQTT-3.1.2-4]
-            c2 = await v5(lst.port, "t_connect_clean_start",
+            c2 = await v5(mk, "t_connect_clean_start",
                           clean_start=False)
             assert c2.connack.session_present is True    # [MQTT-3.1.2-5]
             assert await receive_disconnect_reasoncode(c1) == 142
             await c2.disconnect()
 
-            c3 = await v5(lst.port, "new_client", clean_start=False)
+            c3 = await v5(mk, "new_client", clean_start=False)
             assert c3.connack.session_present is False   # [MQTT-3.1.2-6]
             await c3.disconnect()
         run(loop, go())
@@ -119,14 +178,14 @@ class TestConnection:
         """t_connect_will_message: will stored on CONNECT (MQTT-3.1.2-7),
         published on disconnect-with-will rc=0x04 (MQTT-3.14.2-1,
         MQTT-3.1.2-8), dropped on normal disconnect (MQTT-3.1.2-10)."""
-        node, lst = broker
+        node, mk = broker
 
         async def go():
             will = P.Will(topic=TOPICS[0], payload=b"will message")
-            c1 = await v5(lst.port, "will1", will=will)
+            c1 = await v5(mk, "will1", will=will)
             ch = node.cm.lookup_channel("will1")
             assert ch is not None and ch.will_msg is not None  # 3.1.2-7
-            c2 = await v5(lst.port, "will-sub")
+            c2 = await v5(mk, "will-sub")
             await c2.subscribe(TOPICS[0], qos=2)
             await c1.disconnect(reason_code=4)   # disconnect WITH will
             [msg] = await receive_messages(c2, 1)
@@ -135,8 +194,8 @@ class TestConnection:
             assert msg.qos == 0
             await c2.disconnect()
 
-            c3 = await v5(lst.port, "will2", will=will)
-            c4 = await v5(lst.port, "will-sub2")
+            c3 = await v5(mk, "will2", will=will)
+            c4 = await v5(mk, "will-sub2")
             await c4.subscribe(TOPICS[0], qos=2)
             await c3.disconnect()                # rc 0: will dropped
             assert await receive_messages(c4, 1) == []   # [MQTT-3.1.2-10]
@@ -147,12 +206,12 @@ class TestConnection:
         """t_batch_subscribe: with authorization denying, a batch
         SUBSCRIBE acks 0x87 per filter and batch UNSUBSCRIBE acks 0x11
         per unknown filter."""
-        node, lst = broker
+        node, mk = broker
         node.hooks.add("client.authorize",
                        lambda _ci, _act, _t, _acc: ("stop", "deny"))
 
         async def go():
-            c = await v5(lst.port, "batch_test")
+            c = await v5(mk, "batch_test")
             sa = await c.subscribe([("t1", P.SubOpts(qos=1)),
                                     ("t2", P.SubOpts(qos=2)),
                                     ("t3", P.SubOpts(qos=0))])
@@ -166,13 +225,13 @@ class TestConnection:
         """t_connect_will_retain: will_retain=False delivers retain=False
         (MQTT-3.1.2-14); will_retain=True delivers retain=True to a
         rap subscriber (MQTT-3.1.2-15)."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
             will = P.Will(topic=TOPICS[0], payload=b"will message",
                           retain=False)
-            c1 = await v5(lst.port, "wr1", will=will)
-            c2 = await v5(lst.port, "wr-sub")
+            c1 = await v5(mk, "wr1", will=will)
+            c2 = await v5(mk, "wr-sub")
             await c2.subscribe(TOPICS[0], qos=2, opts={"rap": 1})
             await c1.disconnect(reason_code=4)
             [m1] = await receive_messages(c2, 1)
@@ -181,15 +240,15 @@ class TestConnection:
 
             will_r = P.Will(topic=TOPICS[0], payload=b"will message",
                             qos=1, retain=True)
-            c3 = await v5(lst.port, "wr2", will=will_r)
-            c4 = await v5(lst.port, "wr-sub2")
+            c3 = await v5(mk, "wr2", will=will_r)
+            c4 = await v5(mk, "wr-sub2")
             await c4.subscribe(TOPICS[0], qos=2, opts={"rap": 1})
             await c3.disconnect(reason_code=4)
             [m2] = await receive_messages(c4, 1)
             assert m2.retain is True             # [MQTT-3.1.2-15]
             await c4.disconnect()
             # clean_retained
-            cl = await v5(lst.port, "clean")
+            cl = await v5(mk, "clean")
             await cl.publish(TOPICS[0], b"", qos=1, retain=True)
             await cl.disconnect()
         run(loop, go())
@@ -197,7 +256,7 @@ class TestConnection:
     def test_connect_idle_timeout(self, loop):
         """t_connect_idle_timeout: a socket that never sends CONNECT is
         closed after the zone idle_timeout."""
-        node, lst = make_broker(loop, {"mqtt": {"idle_timeout": 0.3}})
+        node, lst, _mk = make_broker(loop, {"mqtt": {"idle_timeout": 0.3}})
 
         async def go():
             r, _w = await asyncio.open_connection("127.0.0.1", lst.port)
@@ -216,10 +275,10 @@ class TestConnection:
         the asserted property (an idle connection schedules no stats
         work) holds by construction; assert the pull surface works on an
         idle connection."""
-        node, lst = broker
+        node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "stats-idle", keepalive=60)
+            c = await v5(mk, "stats-idle", keepalive=60)
             await asyncio.sleep(0.2)     # idle
             info = node.cm.get_channel_info("stats-idle")
             assert info is not None and info.get("clientid") == "stats-idle"
@@ -233,10 +292,10 @@ class TestConnection:
     def test_connect_keepalive_timeout(self, loop, broker):
         """t_connect_keepalive_timeout: MQTT-3.1.2-22 — a silent client
         is disconnected with rc 141 after ~1.5x keepalive."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "ka", keepalive=1)
+            c = await v5(mk, "ka", keepalive=1)
             # the client sends nothing (no auto-ping): broker must kill it
             rc = await receive_disconnect_reasoncode(c, timeout=6)
             assert rc == 141
@@ -245,19 +304,19 @@ class TestConnection:
     def test_connect_session_expiry_interval(self, loop, broker):
         """t_connect_session_expiry_interval: MQTT-3.1.2-23 — a qos2
         message published while offline is delivered on resume."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "t_connect_session_expiry_interval",
+            c1 = await v5(mk, "t_connect_session_expiry_interval",
                           properties={"session_expiry_interval": 7200})
             await c1.subscribe(TOPICS[0], qos=2)
             await c1.disconnect()
 
-            c2 = await v5(lst.port, "pub")
+            c2 = await v5(mk, "pub")
             await c2.publish(TOPICS[0], b"test message", qos=2)
             await c2.disconnect()
 
-            c3 = await v5(lst.port, "t_connect_session_expiry_interval",
+            c3 = await v5(mk, "t_connect_session_expiry_interval",
                           clean_start=False)
             [msg] = await receive_messages(c3, 1, timeout=3)
             assert msg.topic == TOPICS[0]
@@ -269,11 +328,11 @@ class TestConnection:
     def test_connect_duplicate_clientid(self, loop, broker):
         """t_connect_duplicate_clientid: MQTT-3.1.4-3 — the first
         connection gets DISCONNECT 142."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "t_connect_duplicate_clientid")
-            c2 = await v5(lst.port, "t_connect_duplicate_clientid")
+            c1 = await v5(mk, "t_connect_duplicate_clientid")
+            c2 = await v5(mk, "t_connect_duplicate_clientid")
             assert await receive_disconnect_reasoncode(c1) == 142
             await c2.disconnect()
         run(loop, go())
@@ -282,15 +341,15 @@ class TestConnection:
 class TestConnack:
     def test_connack_session_present(self, loop, broker):
         """t_connack_session_present: MQTT-3.2.2-2/-3."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "sp",
+            c1 = await v5(mk, "sp",
                           properties={"session_expiry_interval": 7200},
                           clean_start=True)
             assert c1.connack.session_present is False   # [MQTT-3.2.2-2]
             await c1.disconnect()
-            c2 = await v5(lst.port, "sp",
+            c2 = await v5(mk, "sp",
                           properties={"session_expiry_interval": 7200},
                           clean_start=False)
             assert c2.connack.session_present is True    # [MQTT-3.2.2-3]
@@ -301,11 +360,11 @@ class TestConnack:
     def test_connack_max_qos_allowed(self, loop, max_qos):
         """t_connack_max_qos_allowed: MQTT-3.2.2-9/-10/-11/-12 for
         max_qos_allowed of 0 and 1 (the =2 leg is the case below)."""
-        node, lst = make_broker(
+        node, lst, mk = make_broker(
             loop, {"mqtt": {"max_qos_allowed": max_qos}})
 
         async def go():
-            c1 = await v5(lst.port, "mq")
+            c1 = await v5(mk, "mq")
             assert c1.connack.properties.get("maximum_qos") == max_qos
             # subscription grants are NOT capped        [MQTT-3.2.2-10]
             assert (await c1.subscribe(TOPICS[0], qos=0)).reason_codes == [0]
@@ -336,10 +395,10 @@ class TestConnack:
     def test_connack_max_qos_allowed_full_range(self, loop, broker):
         """t_connack_max_qos_allowed (max=2 leg): Maximum-QoS is ABSENT
         from CONNACK when the full range is supported [MQTT-3.2.2-9]."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "mq2")
+            c = await v5(mk, "mq2")
             assert "maximum_qos" not in c.connack.properties
             await c.disconnect()
         run(loop, go())
@@ -347,10 +406,10 @@ class TestConnack:
     def test_connack_assigned_clienid(self, loop, broker):
         """t_connack_assigned_clienid (sic): MQTT-3.2.2-16 — empty
         clientid gets a broker-assigned one."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "")
+            c = await v5(mk, "")
             assigned = c.connack.properties.get("assigned_client_identifier")
             assert isinstance(assigned, str) and assigned
             await c.disconnect()
@@ -360,10 +419,10 @@ class TestConnack:
 class TestPublish:
     def test_publish_rap(self, loop, broker):
         """t_publish_rap: MQTT-3.3.1-12/-13 retain-as-published."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "rap1")
+            c1 = await v5(mk, "rap1")
             await c1.subscribe(TOPICS[0], qos=2, opts={"rap": 1})
             await c1.publish(TOPICS[0], b"retained message", qos=1,
                              retain=True)
@@ -371,7 +430,7 @@ class TestPublish:
             assert m1.retain is True             # [MQTT-3.3.1-12]
             await c1.disconnect()
 
-            c2 = await v5(lst.port, "rap2")
+            c2 = await v5(mk, "rap2")
             await c2.subscribe(TOPICS[0], qos=2, opts={"rap": 0})
             await c2.publish(TOPICS[0], b"retained message", qos=1,
                              retain=True)
@@ -379,7 +438,7 @@ class TestPublish:
             assert m2.retain is False            # [MQTT-3.3.1-13]
             await c2.disconnect()
 
-            cl = await v5(lst.port, "clean")
+            cl = await v5(mk, "clean")
             await cl.publish(TOPICS[0], b"", qos=1, retain=True)
             await cl.disconnect()
         run(loop, go())
@@ -387,10 +446,10 @@ class TestPublish:
     def test_publish_wildtopic(self, loop, broker):
         """t_publish_wildtopic: publishing to a wildcard topic NAME gets
         DISCONNECT 144."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "wt")
+            c = await v5(mk, "wt")
             await c.publish(WILD_TOPICS[0], b"error topic")
             assert await receive_disconnect_reasoncode(c) == 144
         run(loop, go())
@@ -398,11 +457,11 @@ class TestPublish:
     def test_publish_payload_format_indicator(self, loop, broker):
         """t_publish_payload_format_indicator: MQTT-3.3.2-6 — the
         property is forwarded unaltered."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
             props = {"payload_format_indicator": 233 & 0xFF}
-            c = await v5(lst.port, "pfi")
+            c = await v5(mk, "pfi")
             await c.subscribe(TOPICS[0], qos=2)
             await c.publish(TOPICS[0], b"Payload Format Indicator",
                             properties=props)
@@ -416,15 +475,15 @@ class TestPublish:
         """t_publish_topic_alias: alias 0 is invalid (DISCONNECT 148,
         MQTT-3.3.2-8); a registered alias routes an empty-topic publish
         (MQTT-3.3.2-12)."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "ta1")
+            c1 = await v5(mk, "ta1")
             await c1.publish(TOPICS[0], b"Topic-Alias",
                              properties={"topic_alias": 0})
             assert await receive_disconnect_reasoncode(c1) == 148
 
-            c2 = await v5(lst.port, "ta2")
+            c2 = await v5(mk, "ta2")
             await c2.subscribe(TOPICS[0], qos=2)
             await c2.publish(TOPICS[0], b"Topic-Alias",
                              properties={"topic_alias": 233})
@@ -437,10 +496,10 @@ class TestPublish:
     def test_publish_response_topic(self, loop, broker):
         """t_publish_response_topic: a wildcard Response-Topic gets
         DISCONNECT 130 (MQTT-3.3.2-14)."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "rt")
+            c = await v5(mk, "rt")
             await c.publish(TOPICS[0], b"Response-Topic",
                             properties={"response_topic": WILD_TOPICS[0]})
             assert await receive_disconnect_reasoncode(c) == 130
@@ -449,7 +508,7 @@ class TestPublish:
     def test_publish_properties(self, loop, broker):
         """t_publish_properties: MQTT-3.3.2-15/-16/-18/-20 — all
         request/response + user properties forwarded unaltered."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
             props = {
@@ -458,7 +517,7 @@ class TestPublish:
                 "user_property": [("a", "2333")],    # [MQTT-3.3.2-18]
                 "content_type": "2333",              # [MQTT-3.3.2-20]
             }
-            c = await v5(lst.port, "pp")
+            c = await v5(mk, "pp")
             await c.subscribe(TOPICS[0], qos=2)
             await c.publish(TOPICS[0], b"Publish Properties",
                             properties=props)
@@ -476,11 +535,11 @@ class TestPublish:
         """t_publish_overlapping_subscriptions: MQTT-3.3.4-2/-3 —
         overlapping subscriptions each deliver, QoS capped by the
         subscription, subscription identifier forwarded."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
             props = {"subscription_identifier": 2333}
-            c = await v5(lst.port, "overlap")
+            c = await v5(mk, "overlap")
             sa1 = await c.subscribe(WILD_TOPICS[0], qos=1,
                                     properties=props)
             assert sa1.reason_codes == [1]
@@ -502,10 +561,10 @@ class TestSubscribe:
         """t_subscribe_topic_alias: outbound aliasing under the client's
         Topic-Alias-Maximum — first delivery topic+alias, repeat delivery
         alias only, second topic un-aliased (budget of 1)."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "sta",
+            c = await v5(mk, "sta",
                          properties={"topic_alias_maximum": 1})
             await c.subscribe(TOPICS[0], qos=2)
             await c.subscribe(TOPICS[1], qos=2)
@@ -531,12 +590,12 @@ class TestSubscribe:
         """t_subscribe_no_local: MQTT-3.8.3-3 — the publishing client's
         own no-local subscription stays silent; the other client's
         delivery arrives."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c1 = await v5(lst.port, "nl1")
+            c1 = await v5(mk, "nl1")
             await c1.subscribe(TOPICS[0], qos=2, opts={"nl": 1})
-            c2 = await v5(lst.port, "nl2")
+            c2 = await v5(mk, "nl2")
             await c2.subscribe(TOPICS[0], qos=2, opts={"nl": 1})
             await c1.publish(TOPICS[0], b"t_subscribe_no_local")
             got_c2 = await receive_messages(c2, 1)
@@ -550,11 +609,11 @@ class TestSubscribe:
         """t_subscribe_actions: MQTT-3.8.4-3/-5/-6/-7/-8 — resubscribe
         replaces the subscription (delivery at the new QoS), batch
         subscribe acks per filter."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
             props = {"subscription_identifier": 2333}
-            c = await v5(lst.port, "actions")
+            c = await v5(mk, "actions")
             assert (await c.subscribe(TOPICS[0], qos=2,
                                       properties=props)).reason_codes == [2]
             assert (await c.subscribe(TOPICS[0], qos=1,
@@ -575,14 +634,14 @@ class TestFlowControl:
         reference's receive-maximum cases): the broker must never exceed
         the client's advertised Receive Maximum of unacknowledged QoS1
         deliveries; acking one frees exactly one more."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "rm-flow",
+            c = await v5(mk, "rm-flow",
                          properties={"receive_maximum": 3})
             c.auto_ack = False      # hold PUBACKs: the window must cap
             await c.subscribe(TOPICS[0], qos=1)
-            pub = await v5(lst.port, "rm-pub")
+            pub = await v5(mk, "rm-pub")
             for i in range(10):
                 await pub.publish(TOPICS[0], b"m%d" % i, qos=1)
             got = await receive_messages(c, 10, timeout=1.0)
@@ -609,10 +668,10 @@ class TestUnsubscribe:
     def test_unscbsctibe(self, loop, broker):
         """t_unscbsctibe (sic): MQTT-3.10.4-4/-5/-6, MQTT-3.11.3-1/-2 —
         per-filter UNSUBACK codes incl. 0x11 for unknown filters."""
-        _node, lst = broker
+        _node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "unsub")
+            c = await v5(mk, "unsub")
             assert (await c.subscribe(TOPICS[0], qos=2)).reason_codes == [2]
             assert (await c.unsubscribe(TOPICS[0])).reason_codes == [0]
             assert (await c.unsubscribe("noExistTopic")).reason_codes == [0x11]
@@ -629,10 +688,10 @@ class TestUnsubscribe:
 class TestPingreq:
     def test_pingreq(self, loop, broker):
         """t_pingreq: MQTT-3.12.4-1 — PINGREQ gets PINGRESP."""
-        node, lst = broker
+        node, mk = broker
 
         async def go():
-            c = await v5(lst.port, "ping")
+            c = await v5(mk, "ping")
             await c.ping()
             await asyncio.sleep(0.1)
             await c.disconnect()
@@ -647,7 +706,7 @@ class TestSharedSubscriptions:
         dispatch-ack enabled, a qos2 publish into a 2-member share group
         is dispatched to exactly ONE member (which dies on receipt, as
         the reference's mecked emqtt does)."""
-        node, lst = make_broker(
+        node, lst, mk = make_broker(
             loop, {"broker": {"shared_dispatch_ack_enabled": True}})
         shared = "$share/sharename/" + TOPICS[0]
         received = []
@@ -662,7 +721,7 @@ class TestSharedSubscriptions:
                 assert (await s.subscribe(shared, qos=2)).reason_codes == [2]
                 subs.append(s)
 
-            pub = await v5(lst.port, "pub_client")
+            pub = await v5(mk, "pub_client")
             await pub.publish(
                 TOPICS[0],
                 b"t_shared_subscriptions_client_terminates_when_qos_eq_2",
