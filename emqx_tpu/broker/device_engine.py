@@ -748,16 +748,17 @@ class DeviceRouteEngine:
             return None
         self._kick_class_warm()
         b = self._built
-        from emqx_tpu.ops.match import encode_topics
+        from emqx_tpu.ops.match import encode_topics_str
         subs = []
         encs = []
         Bp = 64
         for msgs in lives:
-            words_list = [T.tokens(m.topic) for m in msgs]
-            enc, lens, dollar, too_long = encode_topics(
-                self.intern, [w[:self.max_levels] for w in words_list],
-                self.max_levels)
-            subs.append((msgs, words_list, too_long))
+            # one native call per batch (split+hash+probe in C); word
+            # lists are tokenized lazily in _consume_one only when the
+            # delta-trie path actually needs them
+            enc, lens, dollar, too_long = encode_topics_str(
+                self.intern, [m.topic for m in msgs], self.max_levels)
+            subs.append((msgs, None, too_long))
             encs.append((enc, lens, dollar))
             Bp = max(Bp, self._batch_class(len(msgs)))
         if len(lives) > 1:
@@ -923,7 +924,9 @@ class DeviceRouteEngine:
                 counts.append(self._consume_one(
                     msg, matches[k][i], rows[k][i], opts[k][i],
                     shared_sids[k][i], shared_rows[k][i],
-                    shared_opts[k][i], words_list[i], h.dev_shared, b))
+                    shared_opts[k][i],
+                    words_list[i] if words_list is not None else None,
+                    h.dev_shared, b))
             metrics.inc("routing.device.batches")
             return counts
         finally:
@@ -1120,6 +1123,8 @@ class DeviceRouteEngine:
 
         # filters added since the snapshot: host trie + host dispatch
         if self._delta_filter:
+            if words is None:   # prepare defers tokenization (native
+                words = T.tokens(msg.topic)[:self.max_levels]  # encode)
             ids = self.intern.encode_topic(words)
             dol = words[0].startswith("$") if words else False
             for dfid in self._delta_trie.match(ids, dol):

@@ -70,6 +70,19 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.replayq_scan.restype = ctypes.c_int
     lib.replayq_scan.argtypes = [u8p, ctypes.c_size_t, u32p, u32p,
                                  ctypes.c_int]
+    lib.intern_table_new.restype = ctypes.c_int
+    lib.intern_table_new.argtypes = []
+    lib.intern_table_free.restype = None
+    lib.intern_table_free.argtypes = [ctypes.c_int]
+    lib.intern_table_add.restype = ctypes.c_int
+    lib.intern_table_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_uint32, ctypes.c_int32]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.topic_encode_batch.restype = ctypes.c_int
+    lib.topic_encode_batch.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, u32p, u32p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8)]
     _lib = lib
     return _lib
 
@@ -207,6 +220,69 @@ def topic_match(name: str, filter_: str) -> bool:
         return T.match(name, filter_)
     nb, fb = name.encode(), filter_.encode()
     return bool(lib.topic_match(nb, len(nb), fb, len(fb)))
+
+
+# ---------------------------------------------------------------------
+# interned-word mirror + batched topic encode (SURVEY §7 hard-part 3)
+# ---------------------------------------------------------------------
+def intern_mirror_new() -> Optional[int]:
+    """Allocate a native word→id mirror; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.intern_table_new()
+    return h if h >= 0 else None
+
+
+def intern_mirror_free(h: int) -> None:
+    lib = _load()
+    if lib is not None and h is not None and h >= 0:
+        lib.intern_table_free(h)
+
+
+def intern_mirror_add(h: int, word: str, wid: int) -> bool:
+    """Mirror one word→id. The C table stores the word BYTES and
+    confirms lookups with memcmp, so hash collisions between different
+    words are handled by probing, not by failure; False only on
+    allocation failure, a dead handle, or an id conflict for the SAME
+    word (a caller bug) — the caller retires the mirror then."""
+    lib = _load()
+    raw = word.encode()
+    return lib.intern_table_add(h, raw, len(raw), wid) == 0
+
+
+def topic_encode_batch(h: int, topics: list, max_levels: int,
+                       unknown_id: int, pad_id: int):
+    """Encode publish topics in one native call. Returns numpy arrays
+    (ids [n, L] int32, lens [n] int32, dollar [n] bool, too_long [n]
+    bool), or None when the library/handle is unavailable."""
+    lib = _load()
+    if lib is None or h is None or not topics:
+        return None
+    import numpy as np
+    raws = [t.encode() for t in topics]
+    buf = b"".join(raws)
+    n = len(raws)
+    offs = np.zeros(n, np.uint32)
+    lens_in = np.fromiter((len(r) for r in raws), np.uint32, n)
+    if n > 1:
+        np.cumsum(lens_in[:-1], out=offs[1:])
+    ids = np.empty((n, max_levels), np.int32)
+    lens = np.empty(n, np.int32)
+    dollar = np.empty(n, np.uint8)
+    toolong = np.empty(n, np.uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.topic_encode_batch(
+        h, buf, offs.ctypes.data_as(u32p),
+        lens_in.ctypes.data_as(u32p), n, max_levels,
+        unknown_id, pad_id, ids.ctypes.data_as(i32p),
+        lens.ctypes.data_as(i32p), dollar.ctypes.data_as(u8p),
+        toolong.ctypes.data_as(u8p))
+    if rc != n:
+        return None
+    return ids, lens, dollar.astype(bool), toolong.astype(bool)
 
 
 # ---------------------------------------------------------------------

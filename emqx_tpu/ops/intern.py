@@ -31,9 +31,45 @@ class InternTable:
     def __init__(self):
         self._to_id: dict[str, int] = {"+": PLUS, "#": HASH}
         self._to_word: list = [None, "+", "#", None]  # PAD/UNKNOWN unmapped
+        # native mirror (SURVEY §7 hard-part 3): word→id replicated into
+        # the C library (hash-probed, word bytes confirmed by memcmp —
+        # correctness never touches hash uniqueness) so publish batches
+        # encode in one native call. None = not yet attached; False =
+        # permanently retired (library absent, handles exhausted, or an
+        # allocation failure)
+        self._mirror: "int | None | bool" = None
 
     def __len__(self) -> int:
         return len(self._to_word)
+
+    def __del__(self):   # release the C-side handle with the table
+        m = getattr(self, "_mirror", None)
+        if isinstance(m, int):
+            try:
+                from emqx_tpu import native
+                native.intern_mirror_free(m)
+            except Exception:   # noqa: BLE001 — interpreter teardown
+                pass
+
+    def _attach_mirror(self) -> "int | bool":
+        from emqx_tpu import native
+        h = native.intern_mirror_new()
+        if h is None:
+            self._mirror = False
+            return False
+        for word, wid in self._to_id.items():
+            if not native.intern_mirror_add(h, word, wid):
+                native.intern_mirror_free(h)
+                self._mirror = False
+                return False
+        self._mirror = h
+        return h
+
+    def mirror_handle(self) -> "int | bool":
+        """The native mirror handle (attached lazily), or False."""
+        if self._mirror is None:
+            return self._attach_mirror()
+        return self._mirror
 
     def intern(self, word: str) -> int:
         """Get-or-assign an id for a filter word."""
@@ -42,6 +78,11 @@ class InternTable:
             wid = len(self._to_word)
             self._to_id[word] = wid
             self._to_word.append(word)
+            if isinstance(self._mirror, int):
+                from emqx_tpu import native
+                if not native.intern_mirror_add(self._mirror, word, wid):
+                    native.intern_mirror_free(self._mirror)
+                    self._mirror = False
         return wid
 
     def lookup(self, word: str) -> int:

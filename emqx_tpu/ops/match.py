@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from emqx_tpu.ops.intern import PAD
+from emqx_tpu.ops.intern import PAD, UNKNOWN
 from emqx_tpu.ops.trie import MAX_PROBES, TrieTables, mix_hash
 
 
@@ -121,6 +121,26 @@ def match_batch(tables: TrieTables, topics: jax.Array, lens: jax.Array,
 
     oflow = oflow | (count > M)
     return MatchResult(matches=out, counts=jnp.minimum(count, M), overflow=oflow)
+
+
+def encode_topics_str(intern, topics: list, max_levels: int):
+    """Encode publish topics from their raw strings — ONE native call
+    for the whole batch when the library + mirror are available (split,
+    hash, and id-probe per level in C; emqx_tpu/native.py
+    topic_encode_batch), else the python per-word path. Same outputs as
+    encode_topics: (ids [B,L], lens [B], is_dollar [B], too_long [B])."""
+    h = intern.mirror_handle()
+    if h is not False:
+        from emqx_tpu import native
+        out = native.topic_encode_batch(h, topics, max_levels,
+                                        UNKNOWN, PAD)
+        if out is not None:
+            return out
+    from emqx_tpu.utils.topic import tokens
+    # NOT pre-truncated: encode_topics must see the real level count so
+    # deeper-than-L topics get the too_long host-fallback flag (a
+    # truncated topic could falsely match a filter on its prefix)
+    return encode_topics(intern, [tokens(t) for t in topics], max_levels)
 
 
 def encode_topics(intern, topic_words: list, max_levels: int):

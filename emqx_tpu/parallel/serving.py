@@ -461,14 +461,13 @@ class ShardedRouteServer:
         """Stage 1 (event loop): encode one micro-batch (W=1)."""
         if not self.poll_rebuild() or self._builts is None or not lives:
             return None
-        from emqx_tpu.ops.match import encode_topics
+        from emqx_tpu.ops.match import encode_topics_str
         msgs = lives[0]
         Bp = self._batch_class(len(msgs))
         if len(msgs) > Bp:
             return None
-        words = [T.words(m.topic) for m in msgs]
-        enc, lens, dollar, too_long = encode_topics(
-            self.intern, words, self.level_cap)
+        enc, lens, dollar, too_long = encode_topics_str(
+            self.intern, [m.topic for m in msgs], self.level_cap)
         host_idx = set(np.flatnonzero(too_long).tolist())
         pad = Bp - len(msgs)
         if pad:

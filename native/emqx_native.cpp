@@ -22,6 +22,9 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <stdlib.h>
+#include <shared_mutex>
+#include <mutex>
 
 extern "C" {
 
@@ -144,6 +147,177 @@ int topic_match(const char* name, size_t name_len,
         }
     }
     return ni > name_len ? 1 : 0;
+}
+
+// ---------------------------------------------------------------------
+// Interned-word table mirrors + batched topic encoding (SURVEY §7
+// hard-part 3, "strings on TPU"): python's InternTable owns word→id
+// authoritatively; a mirror here stores hash + THE WORD BYTES (arena)
+// so a whole publish batch encodes in one call. Lookups confirm the
+// word with memcmp — correctness never touches hash uniqueness; two
+// words sharing a 64-bit hash simply occupy different probe slots.
+//
+// Concurrency: ctypes releases the GIL around these calls and the
+// engine's background rebuild thread interns filter words while the
+// event loop encodes publish batches — a grow would otherwise free the
+// arrays under a concurrent reader. One global shared_mutex guards all
+// tables: encode takes it shared (once per BATCH, not per word),
+// add/new/free take it exclusive.
+// ---------------------------------------------------------------------
+
+struct WTab {
+    uint64_t* keys;   // 0 = empty slot (a real hash of 0 is remapped)
+    uint32_t* woff;   // word bytes in arena
+    uint32_t* wlen;
+    int32_t*  ids;
+    char*  arena;
+    size_t arena_used, arena_cap;
+    size_t cap;       // power of two
+    size_t used;
+};
+
+#define MAX_WTABS 64
+static WTab g_wtabs[MAX_WTABS];
+static uint8_t g_wtab_live[MAX_WTABS];
+static std::shared_mutex g_wtab_mu;
+
+static inline uint64_t nz(uint64_t h) { return h ? h : 1; }
+
+static inline bool word_eq(const WTab* t, size_t i, const char* w,
+                           size_t n) {
+    return t->wlen[i] == n && memcmp(t->arena + t->woff[i], w, n) == 0;
+}
+
+// probe for (hash, word); returns slot index (occupied-and-equal or
+// first empty)
+static size_t wtab_probe(const WTab* t, uint64_t key, const char* w,
+                         size_t n) {
+    size_t mask = t->cap - 1;
+    size_t i = (size_t)key & mask;
+    while (t->keys[i]) {
+        if (t->keys[i] == key && word_eq(t, i, w, n)) return i;
+        i = (i + 1) & mask;
+    }
+    return i;
+}
+
+static int wtab_grow(WTab* t) {
+    size_t ncap = t->cap ? t->cap * 2 : 1024;
+    uint64_t* nkeys = (uint64_t*)calloc(ncap, sizeof(uint64_t));
+    uint32_t* noff = (uint32_t*)malloc(ncap * sizeof(uint32_t));
+    uint32_t* nlen = (uint32_t*)malloc(ncap * sizeof(uint32_t));
+    int32_t* nids = (int32_t*)malloc(ncap * sizeof(int32_t));
+    if (!nkeys || !noff || !nlen || !nids) {
+        free(nkeys); free(noff); free(nlen); free(nids);
+        return -1;
+    }
+    size_t mask = ncap - 1;
+    for (size_t i = 0; i < t->cap; ++i) {
+        if (!t->keys[i]) continue;
+        size_t j = (size_t)t->keys[i] & mask;
+        while (nkeys[j]) j = (j + 1) & mask;
+        nkeys[j] = t->keys[i]; noff[j] = t->woff[i];
+        nlen[j] = t->wlen[i]; nids[j] = t->ids[i];
+    }
+    free(t->keys); free(t->woff); free(t->wlen); free(t->ids);
+    t->keys = nkeys; t->woff = noff; t->wlen = nlen; t->ids = nids;
+    t->cap = ncap;
+    return 0;
+}
+
+int intern_table_new(void) {
+    std::unique_lock<std::shared_mutex> lk(g_wtab_mu);
+    for (int h = 0; h < MAX_WTABS; ++h) {
+        if (!g_wtab_live[h]) {
+            WTab* t = &g_wtabs[h];
+            memset(t, 0, sizeof(*t));
+            if (wtab_grow(t) != 0) return -1;
+            t->arena_cap = 1 << 16;
+            t->arena = (char*)malloc(t->arena_cap);
+            if (!t->arena) {
+                free(t->keys); free(t->woff); free(t->wlen);
+                free(t->ids); memset(t, 0, sizeof(*t));
+                return -1;
+            }
+            g_wtab_live[h] = 1;
+            return h;
+        }
+    }
+    return -1;      // out of handles: caller stays on the python path
+}
+
+void intern_table_free(int h) {
+    std::unique_lock<std::shared_mutex> lk(g_wtab_mu);
+    if (h < 0 || h >= MAX_WTABS || !g_wtab_live[h]) return;
+    WTab* t = &g_wtabs[h];
+    free(t->keys); free(t->woff); free(t->wlen); free(t->ids);
+    free(t->arena);
+    memset(t, 0, sizeof(*t));
+    g_wtab_live[h] = 0;
+}
+
+// 0 ok; -1 same word already present with a DIFFERENT id (caller bug:
+// intern ids never change); -2 allocation failure / bad handle
+int intern_table_add(int h, const char* word, uint32_t len, int32_t id) {
+    std::unique_lock<std::shared_mutex> lk(g_wtab_mu);
+    if (h < 0 || h >= MAX_WTABS || !g_wtab_live[h]) return -2;
+    WTab* t = &g_wtabs[h];
+    if ((t->used + 1) * 4 >= t->cap * 3 && wtab_grow(t) != 0) return -2;
+    uint64_t key = nz(fnv1a(word, len));
+    size_t i = wtab_probe(t, key, word, len);
+    if (t->keys[i])
+        return t->ids[i] == id ? 0 : -1;
+    if (t->arena_used + len > t->arena_cap) {
+        size_t ncap = t->arena_cap;
+        while (t->arena_used + len > ncap) ncap *= 2;
+        char* na = (char*)realloc(t->arena, ncap);
+        if (!na) return -2;
+        t->arena = na; t->arena_cap = ncap;
+    }
+    memcpy(t->arena + t->arena_used, word, len);
+    t->keys[i] = key;
+    t->woff[i] = (uint32_t)t->arena_used;
+    t->wlen[i] = len;
+    t->ids[i] = id;
+    t->arena_used += len;
+    t->used++;
+    return 0;
+}
+
+// Encode a batch of publish topics: buf holds the topics concatenated,
+// offs/tlens index them. Writes out_ids[n*max_levels] (pad_id beyond a
+// topic's levels), out_lens, out_dollar, out_toolong. Unknown words get
+// unknown_id (they can still match +/# on device). Returns n.
+int topic_encode_batch(int h, const char* buf, const uint32_t* offs,
+                       const uint32_t* tlens, int n, int max_levels,
+                       int32_t unknown_id, int32_t pad_id,
+                       int32_t* out_ids, int32_t* out_lens,
+                       uint8_t* out_dollar, uint8_t* out_toolong) {
+    std::shared_lock<std::shared_mutex> lk(g_wtab_mu);
+    if (h < 0 || h >= MAX_WTABS || !g_wtab_live[h]) return -2;
+    const WTab* t = &g_wtabs[h];
+    for (int i = 0; i < n; ++i) {
+        const char* s = buf + offs[i];
+        size_t len = tlens[i];
+        int32_t* row = out_ids + (size_t)i * max_levels;
+        int levels = 0, toolong = 0;
+        size_t start = 0;
+        for (size_t p = 0; p <= len; ++p) {
+            if (p == len || s[p] == '/') {
+                if (levels >= max_levels) { toolong = 1; break; }
+                size_t wl = p - start;
+                size_t slot = wtab_probe(t, nz(fnv1a(s + start, wl)),
+                                         s + start, wl);
+                row[levels++] = t->keys[slot] ? t->ids[slot] : unknown_id;
+                start = p + 1;
+            }
+        }
+        for (int k = levels; k < max_levels; ++k) row[k] = pad_id;
+        out_lens[i] = levels;
+        out_dollar[i] = (len > 0 && s[0] == '$') ? 1 : 0;
+        out_toolong[i] = (uint8_t)toolong;
+    }
+    return n;
 }
 
 // ---------------------------------------------------------------------

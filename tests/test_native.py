@@ -143,3 +143,90 @@ class TestReplayqScan:
         # torn tail ignored
         spans2 = native.replayq_scan(data + b"\x00\x00\x00\x10partial")
         assert len(spans2) == len(items)
+
+
+class TestInternMirrorEncode:
+    """Native batched topic encode vs the python per-word oracle
+    (encode_topics_str's fast path vs encode_topics)."""
+
+    def _table(self, filters):
+        from emqx_tpu.ops import intern as I
+        t = I.InternTable()
+        for f in filters:
+            t.encode_filter(f.split("/"))
+        return t
+
+    def test_matches_python_oracle(self):
+        import numpy as np
+        from emqx_tpu import native
+        from emqx_tpu.ops import intern as I
+        from emqx_tpu.ops.match import encode_topics, encode_topics_str
+        from emqx_tpu.utils.topic import tokens
+        if not native.available():
+            import pytest
+            pytest.skip("native lib not built")
+        t = self._table(["a/+/c", "device/#", "$SYS/broker/+", "x/y"])
+        topics = ["a/b/c", "device/7/temp", "$SYS/broker/uptime", "x/y",
+                  "never/seen/words", "a", "/", "deep/" * 20 + "end"]
+        L = 8
+        got = encode_topics_str(t, topics, L)
+        want = encode_topics(t, [tokens(tp) for tp in topics], L)
+        for g, w in zip(got, want):
+            assert np.array_equal(np.asarray(g), np.asarray(w)), (g, w)
+        # the fast path really ran (mirror attached, not retired)
+        assert t.mirror_handle() is not False
+
+    def test_new_interned_words_visible_to_mirror(self):
+        from emqx_tpu import native
+        from emqx_tpu.ops.match import encode_topics_str
+        if not native.available():
+            import pytest
+            pytest.skip("native lib not built")
+        t = self._table(["a/b"])
+        ids1, _, _, _ = encode_topics_str(t, ["late/word"], 4)
+        from emqx_tpu.ops.intern import UNKNOWN
+        assert list(ids1[0][:2]) == [UNKNOWN, UNKNOWN]
+        t.encode_filter(["late", "word"])      # intern AFTER attach
+        ids2, _, _, _ = encode_topics_str(t, ["late/word"], 4)
+        assert list(ids2[0][:2]) == [t.lookup("late"), t.lookup("word")]
+
+    def test_add_failure_retires_mirror(self):
+        """Any add failure (id conflict for the same word — a caller
+        bug — or allocation trouble) must permanently retire the
+        mirror: encode falls back to python, stays correct."""
+        from emqx_tpu import native
+        from emqx_tpu.ops import intern as I
+        from emqx_tpu.ops.match import encode_topics_str
+        if not native.available():
+            import pytest
+            pytest.skip("native lib not built")
+        t = I.InternTable()
+        t.encode_filter(["aaa", "bbb"])
+        h = t.mirror_handle()
+        assert isinstance(h, int)
+        # re-adding the SAME word with a different id is a caller bug
+        # the C layer refuses
+        assert native.intern_mirror_add(h, "aaa", 999) is False
+        # the python intern() path retires on that signal
+        orig_add = native.intern_mirror_add
+        try:
+            native.intern_mirror_add = lambda *_a: False
+            t.intern("ccc")
+        finally:
+            native.intern_mirror_add = orig_add
+        assert t._mirror is False
+        ids, lens, dol, tl = encode_topics_str(t, ["aaa/ccc"], 4)
+        assert list(ids[0][:2]) == [t.lookup("aaa"), t.lookup("ccc")]
+
+    def test_handle_reuse_after_free(self):
+        from emqx_tpu import native
+        if not native.available():
+            import pytest
+            pytest.skip("native lib not built")
+        hs = [native.intern_mirror_new() for _ in range(8)]
+        assert all(isinstance(h, int) for h in hs)
+        for h in hs:
+            native.intern_mirror_free(h)
+        h2 = native.intern_mirror_new()
+        assert isinstance(h2, int)
+        native.intern_mirror_free(h2)
