@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Persistent relay-window watcher (round 5, VERDICT item 1).
+
+Rounds 3 and 4 both ended with BENCH value=0 because the axon relay was
+dead during the round-end window — while it may well have been alive
+mid-round. This watcher runs for the whole round:
+
+  * every POLL_S seconds, cheaply checks for a relay listener
+    (`ss -ltn`, can never hang);
+  * at the first live window, proves the backend answers with a
+    disposable child process (a wedged pool hangs jax.devices() inside
+    C, unkillable by Python signals — the watcher itself never imports
+    jax);
+  * then runs the measurement phases SERIALLY, one child process at a
+    time (concurrent pool claims wedge the grant for everyone):
+        1. tools/tpu_matrix.py   — per-stage profile, fold backends,
+                                   rank-block + fuse-width sweeps
+        2. bench.py              — the headline number + configs + e2e
+  * merges each phase's JSON into MEASURED_r05.json and git-commits it
+    IMMEDIATELY, so a window that dies mid-suite still leaves the
+    earlier phases on record and a dead round-end relay can never again
+    erase real data.
+
+After a full success it idles (still probing, still logging) and only
+re-measures when tools/.remeasure exists — drop that file after landing
+a perf change to request a fresh run at the next window.
+
+Run:  nohup python tools/relay_watcher.py >> tools/watcher.log 2>&1 &
+Stop: kill $(cat tools/watcher.pid)   (ALWAYS stop it before the driver
+runs its own round-end bench — two claimants wedge the pool.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MEASURED_r05.json")
+REMEASURE = os.path.join(REPO, "tools", ".remeasure")
+PIDFILE = os.path.join(REPO, "tools", "watcher.pid")
+POLL_S = int(os.environ.get("WATCHER_POLL_S", 20))
+
+_child = None
+
+
+def log(*a):
+    print(time.strftime("[%H:%M:%S]"), *a, file=sys.stderr, flush=True)
+
+
+def relay_listening() -> bool:
+    try:
+        r = subprocess.run(["ss", "-ltn"], capture_output=True,
+                           text=True, timeout=10)
+        return any(":808" in ln for ln in r.stdout.splitlines())
+    except Exception:  # noqa: BLE001 — unknown: let the probe decide
+        return True
+
+
+def run_child(argv, timeout, env=None):
+    """Run one child, return (rc, stdout_text). SIGKILL on timeout —
+    a hung TPU child holds the pool claim, and a plain terminate can
+    leave it wedged in C."""
+    global _child
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    _child = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                              text=True, cwd=REPO, env=full_env)
+    try:
+        out, _ = _child.communicate(timeout=timeout)
+        rc = _child.returncode
+    except subprocess.TimeoutExpired:
+        _child.kill()
+        out, _ = _child.communicate()
+        rc = -9
+    finally:
+        _child = None
+    return rc, out or ""
+
+
+def probe_backend() -> bool:
+    rc, out = run_child(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        timeout=150)
+    log(f"backend probe rc={rc} out={out.strip()[-120:]!r}")
+    return rc == 0
+
+
+def load_out() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def save_and_commit(doc: dict, msg: str):
+    doc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    # commit ONLY this file (pathspec form), retrying index.lock races
+    # with the foreground session's own commits
+    for i in range(12):
+        subprocess.run(["git", "add", "MEASURED_r05.json"], cwd=REPO,
+                       capture_output=True)
+        r = subprocess.run(
+            ["git", "commit", "-m", msg, "--", "MEASURED_r05.json"],
+            cwd=REPO, capture_output=True, text=True)
+        if r.returncode == 0:
+            log(f"committed: {msg}")
+            return
+        time.sleep(5 + i)
+    log(f"commit FAILED after retries: {r.stdout} {r.stderr}")
+
+
+def last_json_line(text: str):
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except Exception:  # noqa: BLE001
+                continue
+    return None
+
+
+def measure_window() -> bool:
+    """One full measurement pass. Returns True if the headline bench
+    phase succeeded with a non-zero value."""
+    doc = load_out()
+    doc.setdefault("attempts", 0)
+    doc["attempts"] += 1
+    git_rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=REPO, capture_output=True, text=True)
+    doc["git_rev"] = git_rev.stdout.strip()
+
+    # phase 1: the matrix (sweeps first — they inform the perf work and
+    # are the data the judge asked for even if the window dies later)
+    log("phase 1: tpu_matrix")
+    t0 = time.time()
+    rc, out = run_child([sys.executable, "tools/tpu_matrix.py"],
+                        timeout=int(os.environ.get("WATCHER_MATRIX_S",
+                                                   2400)))
+    j = last_json_line(out)
+    if j:
+        doc["matrix"] = j
+        doc["matrix_s"] = round(time.time() - t0)
+        save_and_commit(doc, "measure: tpu_matrix sweep on hardware")
+        log(f"matrix ok in {doc['matrix_s']}s: value={j.get('value')}")
+    else:
+        doc["matrix_error"] = f"rc={rc}, no JSON (out tail: {out[-200:]!r})"
+        save_and_commit(doc, "measure: tpu_matrix attempt failed")
+        log(f"matrix FAILED rc={rc}")
+        if not (relay_listening() and probe_backend()):
+            return False  # window died; wait for the next one
+
+    # phase 2: the full bench (headline + configs + config5 + e2e)
+    log("phase 2: bench.py")
+    t0 = time.time()
+    rc, out = run_child(
+        [sys.executable, "bench.py"],
+        timeout=int(os.environ.get("WATCHER_BENCH_S", 4500)),
+        env={"BENCH_INIT_TIMEOUT_S": "120"})
+    j = last_json_line(out)
+    if j:
+        doc["bench"] = j
+        doc["bench_s"] = round(time.time() - t0)
+        ok = bool(j.get("value"))
+        save_and_commit(doc, "measure: full bench on hardware"
+                        if ok else "measure: bench ran, value=0")
+        log(f"bench rc={rc} value={j.get('value')} in {doc['bench_s']}s")
+        return ok
+    doc["bench_error"] = f"rc={rc}, no JSON (out tail: {out[-200:]!r})"
+    save_and_commit(doc, "measure: bench attempt failed")
+    log(f"bench FAILED rc={rc}")
+    return False
+
+
+def main():
+    with open(PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+
+    def bail(signum, frame):
+        log(f"signal {signum}: killing child and exiting")
+        if _child is not None:
+            try:
+                _child.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, bail)
+    signal.signal(signal.SIGINT, bail)
+
+    log(f"watcher up, pid={os.getpid()}, poll={POLL_S}s")
+    last_note = 0.0
+    while True:
+        have = load_out()
+        done = bool(have.get("bench", {}).get("value"))
+        want = (not done) or os.path.exists(REMEASURE)
+        if want and relay_listening():
+            log("relay window detected; probing backend")
+            if probe_backend():
+                if os.path.exists(REMEASURE):
+                    os.unlink(REMEASURE)
+                ok = measure_window()
+                log(f"measurement pass done, headline_ok={ok}")
+            else:
+                time.sleep(POLL_S)
+        else:
+            if time.time() - last_note > 600:
+                state = "complete; drop tools/.remeasure to re-run" \
+                    if done and not want else "waiting for relay window"
+                log(f"idle: {state}")
+                last_note = time.time()
+            time.sleep(POLL_S)
+
+
+if __name__ == "__main__":
+    main()
