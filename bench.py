@@ -47,9 +47,12 @@ def _last_measured():
     erase data that was really measured (rounds 3+4 both lost their
     entire perf story this way)."""
     try:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "MEASURED_r05.json")
-        with open(path) as f:
+        import glob
+        here = os.path.dirname(os.path.abspath(__file__))
+        paths = sorted(glob.glob(os.path.join(here, "MEASURED_r*.json")))
+        if not paths:
+            return None
+        with open(paths[-1]) as f:   # newest round's measurement
             doc = json.load(f)
         keep = {k: doc.get(k) for k in ("ts", "git_rev")}
         bench = doc.get("bench") or {}

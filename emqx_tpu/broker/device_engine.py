@@ -100,6 +100,43 @@ def _next_pow2(x: int) -> int:
 _REMOTE_SID_BASE = 1 << 30
 
 
+def capture_shared(broker, f: str) -> dict:
+    """Per-filter shared-group capture for a device snapshot (used by the
+    single-chip engine AND the mesh ShardedRouteServer).
+
+    Standalone: the local SharedGroup members with their subopts.
+    Clustered: the CLUSTER-WIDE membership (cluster._members — the
+    same sorted (origin, sid) view the host pick uses), with local
+    members carrying subopts and remote members captured as
+    ((origin, sid), None) refs that the build turns into
+    reserved-range device sids. Remote-only groups known purely via
+    replication are captured too — every device-supported strategy's
+    pick runs on device regardless of where members live (reference
+    semantics: emqx_shared_sub.erl:239-268 + replicated group routes
+    :312-320)."""
+    cluster = broker.cluster
+    local = broker.shared.get(f) or {}
+    if cluster is None:
+        return {g: (list(grp.members.items()), grp.cursor)
+                for g, grp in local.items() if grp.members}
+    names = set(local) | cluster._groups_by_real.get(f, set())
+    me = cluster.rpc.node
+    out = {}
+    for g in sorted(names):
+        grp = local.get(g)
+        members = []
+        for origin, sid in cluster._members(broker, f, g):
+            if origin == me:
+                opts = grp.members.get(sid) if grp else None
+                if opts is not None:
+                    members.append((sid, opts))
+            else:
+                members.append(((origin, sid), None))
+        if members:
+            out[g] = (members, grp.cursor if grp else 0)
+    return out
+
+
 class _Built:
     """One compiled snapshot (host-side indexes of the device tables)."""
 
@@ -263,38 +300,7 @@ class DeviceRouteEngine:
         self._apply_build(result, journal=())
 
     def _capture_shared(self, f: str) -> dict:
-        """Per-filter shared-group capture for the snapshot.
-
-        Standalone: the local SharedGroup members with their subopts.
-        Clustered: the CLUSTER-WIDE membership (cluster._members — the
-        same sorted (origin, sid) view the host pick uses), with local
-        members carrying subopts and remote members captured as
-        ((origin, sid), None) refs that the build turns into
-        reserved-range device sids. Remote-only groups known purely via
-        replication are captured too — every device-supported strategy's
-        pick runs on device regardless of where members live."""
-        broker = self.broker
-        cluster = broker.cluster
-        local = broker.shared.get(f) or {}
-        if cluster is None:
-            return {g: (list(grp.members.items()), grp.cursor)
-                    for g, grp in local.items() if grp.members}
-        names = set(local) | cluster._groups_by_real.get(f, set())
-        me = cluster.rpc.node
-        out = {}
-        for g in sorted(names):
-            grp = local.get(g)
-            members = []
-            for origin, sid in cluster._members(broker, f, g):
-                if origin == me:
-                    opts = grp.members.get(sid) if grp else None
-                    if opts is not None:
-                        members.append((sid, opts))
-                else:
-                    members.append(((origin, sid), None))
-            if members:
-                out[g] = (members, grp.cursor if grp else 0)
-        return out
+        return capture_shared(self.broker, f)
 
     def _capture_state_sync(self):
         """Point-in-time copy of the routing state (sync, may stall)."""

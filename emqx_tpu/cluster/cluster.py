@@ -597,14 +597,26 @@ class ClusterNode:
     def _on_membership(self, event: str, node: str) -> None:
         # store purge already handled by ClusterStore; after a purge the
         # local trie may hold dead filters — sweep them
+        broker = self.node.broker
         if event in ("nodedown", "nodeleft"):
-            broker = self.node.broker
             tab = self.store.table(T_ROUTE)
             for f in list(broker.router.topics()):
                 if (not tab.origins(f)
                         and not self._groups_by_real.get(f)
                         and not broker._has_any_sub(f)):
                     broker.router.delete_route(f)
+        # device snapshots bake cluster-wide shared membership in as
+        # remote-ref sids: a membership transition must dirty every
+        # shared slot so the next rebuild re-captures running members
+        # only — otherwise device picks keep forwarding into a corpse
+        # (or exclude a healed member) until unrelated churn. The host
+        # path is immune (it filters by is_running at pick time).
+        eng = getattr(self.node, "device_engine", None)
+        if eng is not None:
+            for real in set(self._groups_by_real) | set(broker.shared):
+                for group in (set(self._groups_by_real.get(real, ()))
+                              | set(broker.shared.get(real, ()))):
+                    eng.note_member_change(real, group)
 
     # ---- introspection (mgmt surface) ----
     def info(self) -> dict:

@@ -27,9 +27,15 @@ and follows as per-shard updates.
 
 Cluster interplay: normal-route forwarding works exactly as the
 single-chip consume (cluster.forward on the matched set). Shared groups
-ride device slots when standalone; under a cluster the shared dispatch
-stays host-side (cluster-wide pick) — combining mesh serving with
-cross-node shared refs is not wired here.
+ride device slots in BOTH modes: standalone slots hold the local
+members; under a cluster each shard's slots hold the CLUSTER-WIDE
+membership (device_engine.capture_shared), remote members as
+reserved-range sids (>= _REMOTE_SID_BASE) that consume turns into
+directed `shared.deliver_fwd` RPCs — the reference's cross-node shared
+dispatch (emqx_shared_sub.erl:239-268) with the pick already made on
+the mesh. Membership replication dirties the filter's shard
+(cluster.py:232 → note_member_change), so the synchronous per-shard
+update keeps the slots cluster-fresh before every served batch.
 
 Reference parity anchors: emqx_broker.erl:199-308 (the per-message path
 this replaces), emqx_router.erl:77-86 (full replication this shards),
@@ -45,8 +51,9 @@ from typing import Optional
 
 import numpy as np
 
-from emqx_tpu.broker.device_engine import (_is_rich, _next_pow2,
-                                           _pack_opts, _unpack_opts)
+from emqx_tpu.broker.device_engine import (_REMOTE_SID_BASE, _is_rich,
+                                           _next_pow2, _pack_opts,
+                                           _unpack_opts, capture_shared)
 from emqx_tpu.broker.message import Message
 from emqx_tpu.ops import intern as I
 from emqx_tpu.utils import topic as T
@@ -56,7 +63,7 @@ class _ShardBuilt:
     """Host index of one shard's compiled tables."""
 
     __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_key", "rich",
-                 "host_extra")
+                 "host_extra", "remote_members")
 
     def __init__(self):
         self.fid_of: dict[str, int] = {}
@@ -65,6 +72,9 @@ class _ShardBuilt:
         self.slot_key: list[tuple] = []      # local slot -> (filter, group)
         self.rich: set[str] = set()          # host-dict dispatch filters
         self.host_extra: list[tuple] = []    # too-deep: (filter, words)
+        # device sid _REMOTE_SID_BASE+i -> (origin, remote_sid): consume
+        # forwards picks for these over RPC (per shard, like _Built's)
+        self.remote_members: list[tuple] = []
 
 
 class _Handle:
@@ -134,6 +144,15 @@ class ShardedRouteServer:
         self._warm_classes: set[int] = set()
         self._warm_thread: Optional[threading.Thread] = None
         self._rebuild_thread: Optional[threading.Thread] = None
+        self._capture_task = None     # pending chunked capture (loop ctx)
+        # build generations: every capture start bumps _build_gen; a
+        # build result adopts only if its gen is newer than the adopted
+        # one, and a pending capture whose gen is no longer current is
+        # SUPERSEDED (a sync rebuild() raced past it) — its result is
+        # dropped rather than regressing the snapshot
+        self._build_gen = 0
+        self._adopted_gen = 0
+        self._capture_gen = 0
         self._rebuild_backoff_until = 0.0
         self._lock = threading.Lock()   # dispatch thread vs loop rebuilds
 
@@ -161,20 +180,27 @@ class ShardedRouteServer:
             buckets[self.shard_of(f)].append(f)
         return buckets
 
-    def _capture_shard(self, mine: list[str]):
-        """(filters, subs, shared) for one shard's bucketed filter list —
-        local members only (see module docstring for the cluster
-        split)."""
+    def _capture_filters(self, fs, subs: dict, shared: dict) -> None:
+        """Capture a sub-list of filters into subs/shared dicts — ONE
+        body shared by the sync shard capture and the chunked async
+        capture, so the two snapshots can never desynchronize. Shared
+        groups capture cluster-wide membership with remote members as
+        ((origin, sid), None) refs (device_engine.capture_shared — same
+        scheme as the single-chip snapshot)."""
         broker = self.broker
-        subs = {f: list(broker.subs[f].items())
-                for f in mine if broker.subs.get(f)}
-        shared = {}
-        if broker.cluster is None:
-            for f in mine:
-                g = broker.shared.get(f)
-                if g:
-                    shared[f] = {gn: (list(grp.members.items()), grp.cursor)
-                                 for gn, grp in g.items() if grp.members}
+        for f in fs:
+            s = broker.subs.get(f)
+            if s:
+                subs[f] = list(s.items())
+            cap = capture_shared(broker, f)
+            if cap:
+                shared[f] = cap
+
+    def _capture_shard(self, mine: list[str]):
+        """(filters, subs, shared) for one shard's bucketed filter list."""
+        subs: dict = {}
+        shared: dict = {}
+        self._capture_filters(mine, subs, shared)
         return mine, subs, shared
 
     def _shard_dims(self, capture) -> dict:
@@ -245,8 +271,16 @@ class ShardedRouteServer:
                 members_raw, cursor = shared_cap[f][gname]
                 slot = len(b.slot_key)
                 b.slot_key.append((f, gname))
-                shared_members[slot] = [(sid, _pack_opts(o))
-                                        for sid, o in members_raw]
+                members = []
+                for sid, o in members_raw:
+                    if isinstance(sid, tuple):
+                        # remote member ref -> reserved-range device sid
+                        dev_sid = _REMOTE_SID_BASE + len(b.remote_members)
+                        b.remote_members.append(sid)
+                        members.append((dev_sid, 0))
+                    else:
+                        members.append((sid, _pack_opts(o)))
+                shared_members[slot] = members
                 filter_slots.setdefault(fid, []).append(slot)
                 cursors.append(cursor)
         b.seg_len = seg_len
@@ -263,18 +297,25 @@ class ShardedRouteServer:
         cur[:len(cursors)] = cursors
         return b, RouterTables(trie=trie, subs=subs_tbl), cur
 
+    def _next_gen(self) -> int:
+        self._build_gen += 1
+        return self._build_gen
+
     def rebuild(self) -> None:
         """Full build, synchronously: capture every shard, compute shared
         capacity classes, compile, stack, place on the mesh. Direct
         callers (tests, boot warm-up) use this; the SERVING path never
         does — poll_rebuild hands full rebuilds to a background thread
-        and serves host-side meanwhile."""
+        and serves host-side meanwhile. Bumps the build generation, so
+        any in-flight background capture/build is superseded (its result
+        would be staler than this one and is dropped at adopt)."""
+        gen = self._next_gen()
         seen = set(self.dirty_shards)
         self.dirty_shards.clear()   # the capture below covers everything
         try:
             self._adopt_full_build(self._full_build(
                 [self._capture_shard(mine)
-                 for mine in self._bucket_filters()]))
+                 for mine in self._bucket_filters()]), gen)
         except Exception:
             # a failed build must not eat the churn marks: the old
             # snapshot keeps serving and those shards still need repair
@@ -299,9 +340,12 @@ class ShardedRouteServer:
             self.mesh, stacked, np.stack(cursors))
         return caps, builts, dev_tables, dev_cursors
 
-    def _adopt_full_build(self, result) -> None:
+    def _adopt_full_build(self, result, gen: int) -> bool:
         caps, builts, dev_tables, dev_cursors = result
         with self._lock:
+            if gen <= self._adopted_gen:
+                return False    # a newer build already adopted: drop
+            self._adopted_gen = gen
             self.tables = dev_tables
             self.cursors = dev_cursors
             self._builts = builts
@@ -312,30 +356,76 @@ class ShardedRouteServer:
                 # under subscribe churn
                 self._warm_classes.clear()
             self._caps = caps
+        return True
 
     def _kick_full_rebuild(self) -> None:
-        """Background full rebuild: CAPTURE on the caller (event-loop)
-        side for a consistent host-state snapshot, COMPILE + UPLOAD on a
-        thread. Serving stays host-side until the swap (prepare_window
-        returns None while this runs) — the single-chip engine's
-        double-buffered rebuild, mesh edition.
+        """Background full rebuild: CAPTURE on the event-loop side in
+        yielding chunks (a large routing state must not stall every
+        connection for the whole capture — round-4 advisor finding;
+        mirrors DeviceRouteEngine._capture_state_async), COMPILE +
+        UPLOAD on a thread. Serving stays host-side until the swap
+        (prepare_window returns None while this runs) — the single-chip
+        engine's double-buffered rebuild, mesh edition.
 
-        Dirty marks for the captured shards clear AT CAPTURE TIME: churn
-        landing while the compile runs re-dirties its shard and follows
-        as a per-shard update after the swap (clearing at adopt time
-        would silently discard it). A failed build restores the marks
-        and backs off before the next attempt — a persistent compile
-        error must not become a tight respawn loop."""
+        Dirty marks clear BEFORE the capture starts: churn landing
+        mid-capture or mid-compile re-dirties its shard and follows as a
+        per-shard update after the swap, which also self-heals any
+        filter the chunked capture saw half-mutated. A failed build
+        restores the marks and backs off before the next attempt — a
+        persistent compile error must not become a tight respawn
+        loop."""
+        import asyncio
         if self._rebuild_thread is not None \
                 and self._rebuild_thread.is_alive():
             return
+        if self._capture_task is not None \
+                and not self._capture_task.done():
+            return
         if time.monotonic() < self._rebuild_backoff_until:
             return
+        gen = self._next_gen()
         seen = set(self.dirty_shards)
-        captures = [self._capture_shard(mine)
-                    for mine in self._bucket_filters()]
         self.dirty_shards -= seen
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (tests / boot warm-up thread): sync capture is fine
+            self._start_build_thread(
+                [self._capture_shard(mine)
+                 for mine in self._bucket_filters()], seen, gen)
+            return
+        self._capture_gen = gen
+        self._capture_task = loop.create_task(
+            self._capture_then_build(seen, gen))
 
+    async def _capture_then_build(self, seen, gen: int) -> None:
+        import asyncio
+        chunk = 2048
+        try:
+            captures = []
+            for mine in self._bucket_filters():
+                subs: dict = {}
+                shared: dict = {}
+                for i in range(0, len(mine), chunk):
+                    self._capture_filters(mine[i:i + chunk], subs, shared)
+                    await asyncio.sleep(0)
+                captures.append((mine, subs, shared))
+        except Exception:   # noqa: BLE001 — surfaced + retried
+            import logging
+            logging.getLogger("emqx_tpu.serving").exception(
+                "chunked mesh capture failed; backing off")
+            self.dirty_shards |= seen
+            self._rebuild_backoff_until = time.monotonic() + 5.0
+            return
+        if gen != self._build_gen:
+            # superseded by a newer capture/rebuild: drop the captures,
+            # but RESTORE the marks — if the superseding build failed,
+            # these shards' churn would otherwise be permanently lost
+            self.dirty_shards |= seen
+            return
+        self._start_build_thread(captures, seen, gen)
+
+    def _start_build_thread(self, captures, seen, gen: int) -> None:
         def work():
             try:
                 result = self._full_build(captures)
@@ -347,7 +437,10 @@ class ShardedRouteServer:
                 self.dirty_shards |= seen
                 self._rebuild_backoff_until = time.monotonic() + 5.0
                 return
-            self._adopt_full_build(result)
+            if not self._adopt_full_build(result, gen):
+                # a newer build won the race; its capture covered this
+                # one's state, but conservatively re-mark the shards
+                self.dirty_shards |= seen
 
         self._rebuild_thread = threading.Thread(target=work, daemon=True)
         self._rebuild_thread.start()
@@ -362,6 +455,10 @@ class ShardedRouteServer:
         if self._rebuild_thread is not None \
                 and self._rebuild_thread.is_alive():
             return False
+        if self._capture_task is not None \
+                and not self._capture_task.done() \
+                and self._capture_gen == self._build_gen:
+            return False    # authoritative capture in progress
         if self._builts is None:
             self._kick_full_rebuild()
             return False
@@ -554,13 +651,26 @@ class ShardedRouteServer:
         broker = self.broker
         return broker._route(msg, broker.router.match(msg.topic))
 
+    def _host_shared_dispatch(self, f: str, gname: str, msg) -> bool:
+        """One group's host-side dispatch: cluster-wide pick under a
+        cluster, local strategy pick standalone (single-chip engine's
+        helper, mesh edition)."""
+        broker = self.broker
+        if broker.cluster is not None:
+            return broker.cluster._dispatch_one_group(broker, f, gname,
+                                                      msg)
+        g = broker.shared.get(f, {}).get(gname)
+        return bool(g and g.members
+                    and broker._shared_pick_deliver(gname, f, g, msg))
+
     def _consume_one(self, msg, i: int, np_res, builts) -> int:
         broker = self.broker
         metrics = self.node.metrics
-        dev_shared = broker.cluster is None and \
-            self.broker.shared_strategy in self._dev_strategies()
+        cluster = broker.cluster
+        dev_shared = self.broker.shared_strategy in self._dev_strategies()
         n = 0
         matched: list[str] = []
+        deep_matched: list[str] = []
         for r in range(self.n_route):
             b = builts[r]
             off = 0
@@ -590,6 +700,7 @@ class ShardedRouteServer:
             for f, _fws in b.host_extra:
                 if T.match(msg.topic, f):
                     matched.append(f)
+                    deep_matched.append(f)
                     n += broker.dispatch(f, msg)
             if dev_shared:
                 srow = np_res["shared_sids"][i, r]
@@ -600,15 +711,43 @@ class ShardedRouteServer:
                         continue
                     f, gname = b.slot_key[slot]
                     sid = int(prow[k])
-                    if sid >= 0 and broker._deliver(
+                    if sid >= _REMOTE_SID_BASE:
+                        # device picked a remote member: directed
+                        # forward, the pick already made on the mesh
+                        if cluster is not None:
+                            origin, rsid = \
+                                b.remote_members[sid - _REMOTE_SID_BASE]
+                            cluster._spawn_fwd(
+                                origin, "shared.deliver_fwd",
+                                [f, gname, rsid, msg.to_wire()],
+                                key=msg.topic)
+                            n += 1
+                            metrics.inc("messages.routed.device")
+                            metrics.inc(
+                                "messages.routed.device.remote_shared")
+                        elif self._host_shared_dispatch(f, gname, msg):
+                            n += 1   # cluster torn down since the build
+                    elif sid >= 0 and broker._deliver(
                             sid, f, msg,
                             dict(_unpack_opts(int(orow[k])), share=gname)):
                         n += 1
                         metrics.inc("messages.routed.device")
         if not dev_shared:
             n += broker._dispatch_shared(msg, matched)
-        if broker.cluster:
-            n += broker.cluster.forward(msg, matched)
+        elif deep_matched:
+            # too-deep filters never get device slots (host_extra above):
+            # their groups dispatch host-side even in device-shared mode
+            # — without this a shared sub on a deep filter got ZERO
+            # deliveries (round-4 advisor finding)
+            for f in deep_matched:
+                names = set(broker.shared.get(f, ()))
+                if cluster is not None:
+                    names |= cluster._groups_by_real.get(f, set())
+                for gname in names:
+                    if self._host_shared_dispatch(f, gname, msg):
+                        n += 1
+        if cluster:
+            n += cluster.forward(msg, matched)
         if n == 0 and not msg.is_sys:
             metrics.inc("messages.dropped")
             metrics.inc("messages.dropped.no_subscribers")
@@ -635,9 +774,17 @@ class ShardedRouteServer:
             if self._builts is None:
                 self.rebuild()
             if not self.poll_rebuild():     # churn kicked a bg rebuild
-                t = self._rebuild_thread
-                if t is not None:
-                    t.join()
+                ct = self._capture_task
+                if ct is not None and not ct.done():
+                    # a loop-side chunked capture is pending and a
+                    # wait=True caller (thread, can't pump the loop)
+                    # needs a snapshot NOW: build synchronously — the
+                    # generation bump supersedes the pending capture
+                    self.rebuild()
+                else:
+                    t = self._rebuild_thread
+                    if t is not None:
+                        t.join()
                 self.poll_rebuild()
         h = self.prepare(msgs)
         if h is None:

@@ -198,10 +198,20 @@ def main():
 
     log(f"watcher up, pid={os.getpid()}, poll={POLL_S}s")
     last_note = 0.0
+    cooloff_until = 0.0
+    max_attempts = int(os.environ.get("WATCHER_MAX_ATTEMPTS", 8))
     while True:
         have = load_out()
         done = bool(have.get("bench", {}).get("value"))
         want = (not done) or os.path.exists(REMEASURE)
+        if os.path.exists(os.path.join(REPO, "tools", ".hold")):
+            want = False  # foreground session is mid-edit; don't measure
+        if time.time() < cooloff_until:
+            want = False  # last pass failed: don't hammer the pool
+        if have.get("attempts", 0) >= max_attempts \
+                and not os.path.exists(REMEASURE):
+            want = False  # persistent failure is not a retry loop
+
         if want and relay_listening():
             log("relay window detected; probing backend")
             if probe_backend():
@@ -209,6 +219,11 @@ def main():
                     os.unlink(REMEASURE)
                 ok = measure_window()
                 log(f"measurement pass done, headline_ok={ok}")
+                if not ok:
+                    # a full failed pass holds the pool claim for up to
+                    # ~2h — cool off so the driver (or a later fix) can
+                    # get a window instead of a tight rerun loop
+                    cooloff_until = time.time() + 900
             else:
                 time.sleep(POLL_S)
         else:
