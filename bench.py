@@ -836,13 +836,18 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
 
 def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             msgs_per_pub: int, use_device: bool) -> dict:
-    """End-to-end PUBLISH→deliver over real TCP sockets.
-
-    Real subscriber connections own `n_filters` wildcard filters
-    (device/{id}/+/{num}/#-shaped); publishers flood QoS0 publishes each
-    matching exactly one filter; throughput = messages delivered to
-    subscriber sockets / wall time. Exercises the full serving path:
-    frame parse → channel → publish batcher → fused device route step →
+    """End-to-end PUBLISH→deliver over real TCP sockets, at BASELINE
+    config 4's workload SHAPE (scaled): `BENCH_E2E_SHARED_PCT` (default
+    50) percent of the wildcard filters are owned by 2-member
+    $share/bg/... groups (round-robin fan-out across different
+    subscriber connections — reference semantics emqx_shared_sub.erl:
+    239-283), the rest are plain subscriptions; publishes carry a QoS
+    mix (every 4th is QoS1, pipelined PUBACKs). Each publish matches
+    exactly one filter, and a shared match delivers to exactly one
+    member, so delivered == sent checks exactly-once end to end.
+    Throughput = messages delivered to subscriber sockets / wall time.
+    Exercises the full serving path: frame parse → channel → publish
+    batcher → fused device route step (with on-device shared picks) →
     RouteResult consumption → session → serialize → socket.
     """
     import asyncio
@@ -863,22 +868,40 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
         await lst.start()
         from emqx_tpu.mqtt import packet as P
 
+        shared_pct = int(os.environ.get("BENCH_E2E_SHARED_PCT", 50))
         ids = max(8, int(np.sqrt(n_filters)))
         nums = max(1, n_filters // ids)
+
+        def is_shared(i: int, n: int) -> bool:
+            return (i * nums + n) % 100 < shared_pct
+
         subs = []
         t0 = time.time()
         opts0 = P.SubOpts(qos=0)
+        opts1 = P.SubOpts(qos=1)
+        n_shared = 0
         for c in range(n_sub_conns):
             cl = Client(port=lst.port, clientid=f"esub{c}")
             await cl.connect()
-            filters = [f"device/d{i}/+/n{n}/#"
-                       for i in range(c, ids, n_sub_conns)
-                       for n in range(nums)]
-            for k in range(0, len(filters), 512):
-                await cl.subscribe([(f, opts0) for f in filters[k:k+512]],
-                                   timeout=30)
+            batch: list = []
+            # plain filters owned by this conn + the SECOND membership of
+            # the previous conn's shared groups (2 members per group, on
+            # different sockets, so round robin alternates sockets)
+            for cc, second in ((c, False),
+                               ((c - 1) % n_sub_conns, True)):
+                for i in range(cc, ids, n_sub_conns):
+                    for n in range(nums):
+                        f = f"device/d{i}/+/n{n}/#"
+                        if is_shared(i, n):
+                            n_shared += not second
+                            batch.append((f"$share/bg/{f}", opts1))
+                        elif not second:
+                            batch.append((f, opts0))
+            for k in range(0, len(batch), 512):
+                await cl.subscribe(batch[k:k + 512], timeout=30)
             subs.append(cl)
-        log(f"e2e: {ids * nums} filters over {n_sub_conns} sub conns "
+        log(f"e2e: {ids * nums} filters ({n_shared} in 2-member shared "
+            f"groups) over {n_sub_conns} sub conns "
             f"in {time.time() - t0:.1f}s (device={use_device})")
 
         pubs = []
@@ -949,19 +972,41 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
         drainers = [asyncio.get_running_loop().create_task(drain(cl))
                     for cl in subs]
 
-        async def flood(cl, seed):
+        async def flood(cl, seed, n_msgs):
+            # QoS mix: every 4th publish is QoS1 with a PIPELINED ack
+            # (bounded outstanding window) — an awaited round trip per
+            # message would serialize the flood on the batcher window
             r = np.random.RandomState(seed)
-            for k in range(msgs_per_pub):
+            acks = []
+            for k in range(n_msgs):
                 i = int(r.randint(0, ids))
                 n = int(r.randint(0, nums))
-                await cl.publish(
+                fut = cl.publish_start(
                     f"device/d{i}/x/n{n}/t",
-                    _struct.pack("d", time.perf_counter()), qos=0)
+                    _struct.pack("d", time.perf_counter()),
+                    qos=1 if k % 4 == 0 else 0)
+                if fut is not None:
+                    acks.append(fut)
+                if len(acks) >= 256:
+                    await _await_acks(acks)
                 if k % 64 == 63:
                     await asyncio.sleep(0)   # let the batcher drain
+            await _await_acks(acks)
+
+        async def _await_acks(acks):
+            # bounded: one lost PUBACK must degrade the number, not hang
+            # the whole measurement window (the bench would be SIGKILLed
+            # with no JSON — the exact failure mode this round fixes)
+            if acks:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*acks, return_exceptions=True), 30)
+                except asyncio.TimeoutError:
+                    log("e2e: PUBACK wait timed out; continuing")
+                acks.clear()
 
         try:
-            await asyncio.gather(*[flood(cl, 100 + c)
+            await asyncio.gather(*[flood(cl, 100 + c, msgs_per_pub)
                                    for c, cl in enumerate(pubs)])
             # drain: wait until all deliveries arrive (bounded)
             deadline = time.time() + 60
@@ -971,23 +1016,74 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
                 await asyncio.sleep(0.05)
         finally:
             hb.cancel()
-            for d in drainers:
-                d.cancel()
         dt = time.time() - t0
         delivered = delivered_n[0]
+        main_lat = sorted(lat)
+        # snapshot the batcher reservoir BEFORE the ladder mixes windows
+        route_lat = (node.publish_batcher.lat_percentiles()
+                     if node.publish_batcher else None)
+
+        def pct_of(ls, p):
+            return round(ls[min(len(ls) - 1, int(len(ls) * p))]
+                         * 1000, 2) if ls else None
+
+        # window ladder (BASELINE p99 criterion): re-run a shorter flood
+        # at descending micro-batch windows ON THE SAME node/subscriptions
+        # to find the tail-vs-throughput knee without re-paying setup
+        ladder_rows = []
+        if use_device and node.publish_batcher is not None \
+                and os.environ.get("BENCH_E2E_LADDER", "1") != "0":
+            for wus_i in (200, 100, 50, 25):
+                node.publish_batcher.window_s = wus_i / 1e6
+                lat.clear()
+                base = delivered_n[0]
+                n_l = max(64, msgs_per_pub // 4)
+                lt0 = time.time()
+                await asyncio.gather(*[flood(cl, 7000 + wus_i + c, n_l)
+                                       for c, cl in enumerate(pubs)])
+                ldeadline = time.time() + 30
+                want_l = base + n_l * len(pubs)
+                while time.time() < ldeadline:
+                    if delivered_n[0] >= want_l:
+                        break
+                    await asyncio.sleep(0.05)
+                ldt = time.time() - lt0
+                lrow = sorted(lat)
+                ladder_rows.append({
+                    "window_us": wus_i,
+                    "per_sec": round((delivered_n[0] - base) / ldt),
+                    "lat_p50_ms": pct_of(lrow, 0.50),
+                    "lat_p99_ms": pct_of(lrow, 0.99),
+                })
+                log(f"ladder window={wus_i}us: "
+                    f"{ladder_rows[-1]['per_sec']}/s "
+                    f"p50={ladder_rows[-1]['lat_p50_ms']}ms "
+                    f"p99={ladder_rows[-1]['lat_p99_ms']}ms")
+
+        for d in drainers:
+            d.cancel()
         for cl in pubs + subs:
             await cl.disconnect()
         await lst.stop()
-        lat.sort()
+        lat = main_lat
 
         def pct(p):
-            return round(lat[min(len(lat) - 1, int(len(lat) * p))]
-                         * 1000, 2) if lat else None
+            return pct_of(lat, p)
 
+        out_extra = {}
+        if ladder_rows:
+            out_extra["window_ladder"] = ladder_rows
+            best = min(ladder_rows,
+                       key=lambda r: (r["lat_p99_ms"] is None,
+                                      r["lat_p99_ms"]))
+            out_extra["best_window_us"] = best["window_us"]
         return {
             "delivered": delivered,
             "sent": total,
+            "shared_pct": shared_pct,
+            "qos1_pct": 25,
             "per_sec": round(delivered / dt),
+            **out_extra,
             # client-observed PUBLISH→deliver latency over the whole
             # flood (includes socket + frame + batcher window + route +
             # session + serialize) — the BASELINE.md p99 criterion's
@@ -995,8 +1091,7 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             "lat_p50_ms": pct(0.50),
             "lat_p99_ms": pct(0.99),
             # batcher-internal PUBLISH→route (enqueue → batch complete)
-            "route_lat": (node.publish_batcher.lat_percentiles()
-                          if node.publish_batcher else None),
+            "route_lat": route_lat,
             "device_routed": node.metrics.val("messages.routed.device"),
             "batches": node.metrics.val("routing.device.batches"),
             # adaptive choice: batches the measured-cost router sent to

@@ -222,14 +222,15 @@ class Client:
         self._send(P.Unsubscribe(packet_id=pid, filters=filters))
         return await asyncio.wait_for(fut, timeout)
 
-    async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
+    def publish_start(self, topic: str, payload: bytes = b"", qos: int = 0,
                       retain: bool = False,
-                      properties: Optional[dict] = None,
-                      timeout: float = 5.0) -> Optional[P.Packet]:
+                      properties: Optional[dict] = None):
+        """Send a PUBLISH without awaiting its ack: for qos>0 returns the
+        ack future (await it later — pipelined publishing keeps a flood's
+        connections full instead of stalling a round trip per message)."""
         if qos == 0:
             self._send(P.Publish(topic=topic, payload=payload, qos=0,
                                  retain=retain, properties=properties))
-            await self._writer.drain()
             return None
         pid = self._alloc()
         fut = asyncio.get_event_loop().create_future()
@@ -237,6 +238,16 @@ class Client:
         self._send(P.Publish(topic=topic, payload=payload, qos=qos,
                              retain=retain, packet_id=pid,
                              properties=properties))
+        return fut
+
+    async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
+                      retain: bool = False,
+                      properties: Optional[dict] = None,
+                      timeout: float = 5.0) -> Optional[P.Packet]:
+        fut = self.publish_start(topic, payload, qos, retain, properties)
+        if fut is None:
+            await self._writer.drain()
+            return None
         return await asyncio.wait_for(fut, timeout)
 
     async def recv(self, timeout: float = 5.0) -> P.Publish:

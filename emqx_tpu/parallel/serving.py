@@ -727,11 +727,21 @@ class ShardedRouteServer:
                                 "messages.routed.device.remote_shared")
                         elif self._host_shared_dispatch(f, gname, msg):
                             n += 1   # cluster torn down since the build
-                    elif sid >= 0 and broker._deliver(
-                            sid, f, msg,
-                            dict(_unpack_opts(int(orow[k])), share=gname)):
-                        n += 1
-                        metrics.inc("messages.routed.device")
+                    elif sid >= 0:
+                        if broker._deliver(
+                                sid, f, msg,
+                                dict(_unpack_opts(int(orow[k])),
+                                     share=gname)):
+                            n += 1
+                            metrics.inc("messages.routed.device")
+                        elif self._host_shared_dispatch(f, gname, msg):
+                            # the picked member vanished between the
+                            # snapshot and this consume (in-flight churn
+                            # window): retry the remaining members
+                            # host-side, like the single-chip engine's
+                            # dirty-slot fallback and the host pick's
+                            # own failover order
+                            n += 1
         if not dev_shared:
             n += broker._dispatch_shared(msg, matched)
         elif deep_matched:

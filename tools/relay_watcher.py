@@ -51,10 +51,18 @@ def log(*a):
 
 
 def relay_listening() -> bool:
+    """The axon relay listens on 127.0.0.1:8082+ when alive. Match the
+    local-address column exactly (a dev server on e.g. :8080 must not
+    read as a relay window and churn probe children)."""
+    import re
     try:
         r = subprocess.run(["ss", "-ltn"], capture_output=True,
                            text=True, timeout=10)
-        return any(":808" in ln for ln in r.stdout.splitlines())
+        for ln in r.stdout.splitlines()[1:]:
+            cols = ln.split()
+            if len(cols) >= 4 and re.search(r":(808[2-9])$", cols[3]):
+                return True
+        return False
     except Exception:  # noqa: BLE001 — unknown: let the probe decide
         return True
 
