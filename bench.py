@@ -690,10 +690,17 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
       route_sync_per_s   bulk route-add convergence rate onto the peer
       route_sync_p50/p99_ms   single route add → visible-on-peer latency
       replay_per_s       retained replay burst rate to a late subscriber
-    Scales via BENCH_C5_ROUTES / BENCH_C5_RETAINED (defaults 50k / 20k —
-    the 10M-sub shape's control-plane cost per route is scale-linear,
-    so the rate extrapolates; running 10M route adds through a bench
-    window would measure patience, not design).
+      stated_shape       the BASELINE row-5 10M shape: measured per-route
+                         cost × 10M as extrapolated wall time
+
+    Scales via BENCH_C5_ROUTES / BENCH_C5_RETAINED (defaults 1M / 100k).
+    The stated shape is 10M: that run is TIME-bound, not memory-bound —
+    replication is batched (store.add_many: one RPC frame per 4096
+    routes) and scale-linear (no resync storms; anti-entropy only fires
+    on real loss), so the 1M default measures the same per-route cost
+    the 10M shape pays; set BENCH_C5_ROUTES=10000000 to run it in full
+    (≈10-12 min on one core; the section timeout scales with the
+    requested count).
     """
     import asyncio
 
@@ -708,7 +715,10 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
         nodes, clusters = [], []
         for i in range(2):
             node = Node(use_device=False, name=f"b{i}@127.0.0.1")
-            cn = ClusterNode(node, port=0, heartbeat_s=0.5)
+            # 1s beats: on one core a bulk route burst can hold the loop
+            # for ~100ms stretches; 0.5s beats with a 2×beat timeout
+            # produced false nodedowns mid-bench → purge+resync storms
+            cn = ClusterNode(node, port=0, heartbeat_s=1.0)
             await cn.start()
             nodes.append(node)
             clusters.append(cn)
@@ -730,14 +740,16 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
             t0 = time.perf_counter()
             for i in range(n_routes):
                 b0.subscribe(sid, f"c5/d{i}/+/t/#")
-                if i % 2048 == 2047:
+                if i % 256 == 255:
+                    # frequent yields keep heartbeats + the replication
+                    # drain timely on one core
                     await asyncio.sleep(0)
             await clusters[0].flush()
-            deadline = time.perf_counter() + 120
+            deadline = time.perf_counter() + max(120, n_routes // 5000)
             while time.perf_counter() < deadline:
                 if tab1.count() - base >= n_routes:
                     break
-                await asyncio.sleep(0.01)
+                await asyncio.sleep(0.05)
             dt = time.perf_counter() - t0
             synced = tab1.count() - base
             out["route_sync"] = {
@@ -745,8 +757,16 @@ def run_config5(n_routes: int, n_retained: int) -> dict:
                 "per_s": round(synced / dt),
                 "wall_s": round(dt, 2),
             }
+            # BASELINE row 5's stated 10M shape at the measured linear
+            # per-route cost (run it in full with BENCH_C5_ROUTES=10000000)
+            out["stated_shape"] = {
+                "routes": 10_000_000,
+                "extrapolated_wall_s": round(10_000_000 * dt / max(1, synced)),
+                "measured_at": int(synced),
+            }
             log(f"config5 route-sync: {synced} routes -> peer in "
-                f"{dt:.2f}s ({synced / dt / 1e3:.1f}k/s)")
+                f"{dt:.2f}s ({synced / dt / 1e3:.1f}k/s; 10M shape "
+                f"≈ {out['stated_shape']['extrapolated_wall_s']}s)")
 
             # --- single-add propagation latency (the visible tail an
             # individual SUBSCRIBE pays before cluster-wide matching)
@@ -1073,10 +1093,11 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
         out_extra = {}
         if ladder_rows:
             out_extra["window_ladder"] = ladder_rows
-            best = min(ladder_rows,
-                       key=lambda r: (r["lat_p99_ms"] is None,
-                                      r["lat_p99_ms"]))
-            out_extra["best_window_us"] = best["window_us"]
+            measured = [r for r in ladder_rows
+                        if r["lat_p99_ms"] is not None]
+            if measured:
+                out_extra["best_window_us"] = min(
+                    measured, key=lambda r: r["lat_p99_ms"])["window_us"]
         return {
             "delivered": delivered,
             "sent": total,
@@ -1221,11 +1242,17 @@ def main():
 
                 signal.signal(signal.SIGALRM, _c5_alarm)
                 try:
+                    c5_routes = int(os.environ.get("BENCH_C5_ROUTES",
+                                                   1_000_000))
+                    # the section watchdog scales with the requested
+                    # count so BENCH_C5_ROUTES=10000000 (the stated
+                    # shape in full) is runnable without extra knobs
                     signal.alarm(int(os.environ.get(
-                        "BENCH_C5_TIMEOUT_S", 600)))
+                        "BENCH_C5_TIMEOUT_S",
+                        max(600, 300 + c5_routes // 5_000))))
                     result["config5"] = run_config5(
-                        int(os.environ.get("BENCH_C5_ROUTES", 50_000)),
-                        int(os.environ.get("BENCH_C5_RETAINED", 20_000)))
+                        c5_routes,
+                        int(os.environ.get("BENCH_C5_RETAINED", 100_000)))
                 except Exception as e:  # noqa: BLE001 — best-effort
                     signal.alarm(0)
                     log(f"config5 failed: {type(e).__name__}: {e}")

@@ -44,17 +44,20 @@ no host dict walk. Messages flagged overflow/too-deep fall back to the full
 host path (emqx_router.erl:136-141 short-circuit analog).
 
 Shared subscriptions: device picks (ops.shared cursors) drive delivery for
-every device-supported strategy (round_robin / random / hash_*), clustered
-or not. Under a cluster the snapshot's member list is the CLUSTER-WIDE
+EVERY strategy (round_robin / random / hash_* / sticky), clustered or
+not. Under a cluster the snapshot's member list is the CLUSTER-WIDE
 membership (emqx_shared_sub:pick semantics over all nodes' members,
 emqx_shared_sub.erl:239-268): local members carry their subopts, remote
 members ride as reserved-range sids (>= _REMOTE_SID_BASE) that index a
 host-side (origin, remote_sid) list — a remote pick is forwarded with the
 same directed shared.deliver_fwd RPC the host path uses
-(emqx_shared_sub.erl dispatch's cross-node SubPid ! send). Only the sticky
-strategy stays host-side (its pick is feedback-dependent). A remote
-join/leave dirties the slot (store watcher → note_member_change) so the
-group serves host-side until the next rebuild.
+(emqx_shared_sub.erl dispatch's cross-node SubPid ! send). Sticky rides
+the cursor state reinterpreted as an affinity pointer (seeded by
+capture_shared, never advanced on device); only RE-picking after a
+member death is feedback-dependent and runs host-side via the consume
+fallback (emqx_shared_sub.erl:269-283). A remote join/leave dirties the
+slot (store watcher → note_member_change) so the group serves host-side
+until the next rebuild.
 """
 
 from __future__ import annotations
@@ -113,27 +116,55 @@ def capture_shared(broker, f: str) -> dict:
     replication are captured too — every device-supported strategy's
     pick runs on device regardless of where members live (reference
     semantics: emqx_shared_sub.erl:239-268 + replicated group routes
-    :312-320)."""
+    :312-320).
+
+    For the `sticky` strategy the returned cursor is the sticky member's
+    INDEX in the members list (establishing affinity on the first
+    capture if none exists) — the device kernel reinterprets the cursor
+    as the affinity pointer and never advances it (ops.shared)."""
     cluster = broker.cluster
+    sticky_mode = broker.shared_strategy == "sticky"
     local = broker.shared.get(f) or {}
     if cluster is None:
-        return {g: (list(grp.members.items()), grp.cursor)
-                for g, grp in local.items() if grp.members}
+        out = {}
+        for g, grp in local.items():
+            if not grp.members:
+                continue
+            members = list(grp.members.items())
+            cursor = grp.cursor
+            if sticky_mode:
+                if grp.sticky not in grp.members:
+                    grp.sticky = members[0][0]   # establish affinity
+                cursor = next(i for i, (sid, _) in enumerate(members)
+                              if sid == grp.sticky)
+            out[g] = (members, cursor)
+        return out
     names = set(local) | cluster._groups_by_real.get(f, set())
     me = cluster.rpc.node
     out = {}
     for g in sorted(names):
         grp = local.get(g)
         members = []
+        refs = []                      # (origin, sid) per kept member
         for origin, sid in cluster._members(broker, f, g):
             if origin == me:
                 opts = grp.members.get(sid) if grp else None
                 if opts is not None:
                     members.append((sid, opts))
+                    refs.append((origin, sid))
             else:
                 members.append(((origin, sid), None))
-        if members:
-            out[g] = (members, grp.cursor if grp else 0)
+                refs.append((origin, sid))
+        if not members:
+            continue
+        cursor = grp.cursor if grp else 0
+        if sticky_mode:
+            want = cluster._shared_sticky.get((f, g))
+            if want not in refs:
+                want = refs[0]         # establish cluster-wide affinity
+                cluster._shared_sticky[(f, g)] = want
+            cursor = refs.index(want)
+        out[g] = (members, cursor)
     return out
 
 
@@ -1171,11 +1202,19 @@ class DeviceRouteEngine:
                     elif self._host_shared_dispatch(f, gname, msg):
                         # cluster torn down since the build: host decides
                         n += 1
-                elif sid >= 0 and broker._deliver(
-                        sid, f, msg,
-                        dict(_unpack_opts(int(so_row[k])), share=gname)):
-                    n += 1
-                    metrics.inc("messages.routed.device")
+                elif sid >= 0:
+                    if broker._deliver(
+                            sid, f, msg,
+                            dict(_unpack_opts(int(so_row[k])),
+                                 share=gname)):
+                        n += 1
+                        metrics.inc("messages.routed.device")
+                    elif self._host_shared_dispatch(f, gname, msg):
+                        # picked member vanished in the in-flight churn
+                        # window: host re-pick over the live members (for
+                        # sticky this is also where affinity re-homes,
+                        # emqx_shared_sub.erl:269-283)
+                        n += 1
             cluster = broker.cluster
             for f in matched:
                 # groups created after the snapshot on matched filters

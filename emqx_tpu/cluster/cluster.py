@@ -157,13 +157,54 @@ class ClusterNode:
         self._repl_q.put_nowait((coro_fn, args))
 
     async def _repl_worker(self) -> None:
+        """Single-writer drain with COALESCING: a backlog of plain
+        store.add ops (bulk subscribe churn) folds same-table runs into
+        one add_many — one RPC frame per ~4k routes instead of one per
+        route. Order is preserved: items run in queue order, and a
+        non-add op flushes the pending run before it executes."""
         while True:
-            coro_fn, args = await self._repl_q.get()
-            try:
-                await coro_fn(*args)
-            except Exception:  # noqa: BLE001
-                log.exception("replication op failed")
-            finally:
+            items = [await self._repl_q.get()]
+            while len(items) < 8192:
+                try:
+                    items.append(self._repl_q.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            run_table = None
+            run: list = []
+
+            async def flush_run():
+                nonlocal run, run_table
+                if run:
+                    r, t = run, run_table
+                    run, run_table = [], None   # clear BEFORE the await:
+                    # add_many applies locally in full before casting, so
+                    # a cast failure must not re-run the local applies
+                    await self.store.add_many(t, r)
+
+            async def safely(coro):
+                try:
+                    await coro
+                except Exception:  # noqa: BLE001 — log, keep draining:
+                    # local applies precede casts, so a lost cast is
+                    # healed by anti-entropy; aborting the rest of the
+                    # drain would lose LOCAL applies too
+                    log.exception("replication op failed")
+
+            for coro_fn, args in items:
+                # NOTE == not `is`: each `self.store.add` access builds a
+                # fresh bound method; `is` would never match and silently
+                # disable coalescing entirely
+                if coro_fn == self.store.add:
+                    table, key, value = args
+                    if run and table != run_table:
+                        await safely(flush_run())
+                    run_table = table
+                    run.append((key, value))
+                else:
+                    await safely(flush_run())
+                    await safely(coro_fn(*args))
+            await safely(flush_run())
+            for _ in items:
                 self._repl_q.task_done()
 
     async def flush(self) -> None:

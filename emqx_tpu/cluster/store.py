@@ -34,6 +34,8 @@ class Table:
     def __init__(self, name: str):
         self.name = name
         self.rows: dict[Any, dict[str, list]] = {}
+        self._count = 0       # live value count (count() is polled in
+        #                       sync loops — O(n) scans there are O(n²))
         # fn(op, key, value, origin) on every applied mutation
         self.watchers: list[Callable[[str, Any, Any, str], None]] = []
 
@@ -42,6 +44,7 @@ class Table:
             vals = self.rows.setdefault(key, {}).setdefault(origin, [])
             if value not in vals:
                 vals.append(value)
+                self._count += 1
         elif op == "del":
             per = self.rows.get(key)
             if per is None:
@@ -53,6 +56,7 @@ class Table:
                 vals.remove(value)
             except ValueError:
                 return
+            self._count -= 1
             if not vals:
                 del per[origin]
             if not per:
@@ -76,8 +80,7 @@ class Table:
         return list(self.rows)
 
     def count(self) -> int:
-        return sum(len(vals) for per in self.rows.values()
-                   for vals in per.values())
+        return self._count
 
 
 class ClusterStore:
@@ -101,6 +104,7 @@ class ClusterStore:
         self._lag_seen: dict[str, int] = {}   # origin -> applied at last check
         self._ae_task: Optional[asyncio.Task] = None
         rpc.register("store.op", self._h_op)
+        rpc.register("store.op_batch", self._h_op_batch)
         rpc.register("store.snapshot", self._h_snapshot)
         rpc.register("store.seq", self._h_seq)
         membership.monitor(self._on_membership)
@@ -159,10 +163,37 @@ class ClusterStore:
                                  value],
                                 key=f"{table}:{key}")
 
-    async def _h_op(self, origin: str, inc: int, seq: int, op: str,
-                    table: str, key: Any, value: Any) -> None:
-        if isinstance(key, list):        # tuple keys round-trip as JSON lists
-            key = tuple(key)
+    async def add_many(self, table: str, items: list) -> None:
+        """Bulk add: [(key, value)] applied locally + broadcast as ONE
+        `store.op_batch` cast per peer per chunk. Bulk route churn (a
+        10M-sub boot, a mass resubscribe) is RPC-frame-bound, not
+        trie-bound: per-op casts cost an encode/decode round per route
+        AND starve the heartbeat loop into false nodedowns (observed: a
+        200k-route burst triggered repeated full resyncs). Receiver-side
+        ordering needs no channel pinning — the per-origin seq buffer
+        already applies ops in seq order whatever channel they rode."""
+        me = self.rpc.node
+        tab = self.table(table)
+        batch = []
+        for i, (key, value) in enumerate(items):
+            self._seq += 1
+            tab._apply("add", key, value, me)
+            batch.append([self._seq, "add", table, key, value])
+            if i % 1024 == 1023:
+                # watchers do trie/index work per apply: yield so a big
+                # coalesced run can't hold the loop into heartbeat misses
+                await asyncio.sleep(0)
+        peers = self.membership.other_nodes()
+        CHUNK = 4096
+        for i in range(0, len(batch), CHUNK):
+            chunk = batch[i:i + CHUNK]
+            for node in peers:
+                await self.rpc.cast(node, "store.op_batch",
+                                    [me, self._inc, chunk],
+                                    key=f"{table}:batch")
+
+    def _check_incarnation(self, origin: str, inc: int) -> bool:
+        """Track the origin's boot incarnation; False = stale straggler."""
         known_inc = self._origin_inc.get(origin)
         if known_inc is None or inc > known_inc:
             # first contact, or the origin RESTARTED: its old rows are a
@@ -175,7 +206,14 @@ class ClusterStore:
             self._applied[origin] = 0
             self._buffer.pop(origin, None)
         elif inc < known_inc:
-            return          # straggler from a dead incarnation: drop
+            return False      # straggler from a dead incarnation: drop
+        return True
+
+    def _recv_op(self, origin: str, seq: int, op: str, table: str,
+                 key: Any, value: Any) -> None:
+        """Seq-ordered apply with out-of-order buffering."""
+        if isinstance(key, list):        # tuple keys round-trip as JSON lists
+            key = tuple(key)
         last = self._applied.get(origin, 0)
         if seq <= last:
             return                          # duplicate
@@ -188,6 +226,20 @@ class ClusterStore:
         self._applied[origin] = last
         # a gap means casts raced ahead on different channels; the buffered
         # ops apply the moment the missing seq arrives
+
+    async def _h_op(self, origin: str, inc: int, seq: int, op: str,
+                    table: str, key: Any, value: Any) -> None:
+        if self._check_incarnation(origin, inc):
+            self._recv_op(origin, seq, op, table, key, value)
+
+    async def _h_op_batch(self, origin: str, inc: int,
+                          batch: list) -> None:
+        if not self._check_incarnation(origin, inc):
+            return
+        for i, (seq, op, table, key, value) in enumerate(batch):
+            self._recv_op(origin, seq, op, table, key, value)
+            if i % 1024 == 1023:
+                await asyncio.sleep(0)   # see add_many: loop liveness
 
     # ---- snapshot sync (mnesia copy_table analog) ----
     def _snapshot(self) -> dict:

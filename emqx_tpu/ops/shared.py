@@ -28,12 +28,22 @@ STRATEGY_ROUND_ROBIN = 0
 STRATEGY_RANDOM = 1       # pseudo-random: hash of (msg seed, slot)
 STRATEGY_HASH_TOPIC = 2   # stable per topic-hash
 STRATEGY_HASH_CLIENT = 3  # stable per publisher-hash
+STRATEGY_STICKY = 4       # persistent per-slot member (cursor = affinity)
 STRATEGIES = {
     "round_robin": STRATEGY_ROUND_ROBIN,
     "random": STRATEGY_RANDOM,
     "hash_topic": STRATEGY_HASH_TOPIC,
     "hash_clientid": STRATEGY_HASH_CLIENT,
-    # 'sticky' is host-side (needs per-consumer affinity state, rare path)
+    # sticky rides the SAME cursor state as round_robin, reinterpreted:
+    # the host seeds each slot's cursor with its sticky member's index
+    # (device_engine.capture_shared) and the kernel never advances it —
+    # every message in every batch picks cursor % size, so affinity
+    # holds within and across batches with zero feedback from the
+    # device. Re-picks (member death/unsubscribe) are feedback-dependent
+    # and stay host-side: the consume fallback picks a new member, the
+    # host record updates, and the next snapshot re-seeds the cursor
+    # (reference: emqx_shared_sub.erl:269-283).
+    "sticky": STRATEGY_STICKY,
 }
 
 
@@ -185,7 +195,9 @@ def pick_members(table: SubTable, cursors: jax.Array, sids: jax.Array,
     base_hash = (msg_hash[:, None].astype(jnp.uint32)
                  * jnp.uint32(0x9E3779B1) ^ safe.astype(jnp.uint32)).astype(jnp.int32)
     base = jnp.where(strategy == STRATEGY_ROUND_ROBIN, base_rr,
-                     jnp.abs(base_hash))
+                     jnp.where(strategy == STRATEGY_STICKY,
+                               cursors[safe],      # affinity, no rank
+                               jnp.abs(base_hash)))
     member = jnp.where(nonempty, base % jnp.maximum(size, 1), 0)
     idx = lo + member
     rows = jnp.where(nonempty, table.shared_row[jnp.clip(idx, 0)], -1)

@@ -131,6 +131,27 @@ class TestSharedPick:
         assert int(sp.rows[0, 0]) == int(sp.rows[1, 0])  # sticky per hash
         assert list(np.asarray(sp.new_cursors)) == [0, 0]  # no advance
 
+    def test_sticky_strategy_affinity(self):
+        """Sticky: the cursor is the affinity pointer (seeded host-side
+        with the sticky member's index, emqx_shared_sub.erl:269-283);
+        every message in every batch picks it and it never advances."""
+        from emqx_tpu.ops.shared import STRATEGY_STICKY
+        intern, tables = self.setup_tables()
+        enc, lens, dollar = encode(intern, ["job/1", "job/2", "job/3"])
+        mr = match_batch(tables.trie, enc, lens, dollar)
+        sids, _ = shared_slots(tables.subs, mr.matches)
+        cursors = np.array([1, 0], np.int32)   # slot0 stuck on member 101
+        sp = pick_members(tables.subs, cursors, sids,
+                          np.int32(STRATEGY_STICKY), np.zeros(3, np.int32))
+        assert [int(r) for r in sp.rows[:, 0]] == [101, 101, 101]
+        assert [int(r) for r in sp.rows[:, 1]] == [200, 200, 200]
+        assert list(np.asarray(sp.new_cursors)) == [1, 0]  # no advance
+        # next batch keeps the affinity
+        sp2 = pick_members(tables.subs, np.asarray(sp.new_cursors), sids,
+                           np.int32(STRATEGY_STICKY),
+                           np.zeros(3, np.int32))
+        assert [int(r) for r in sp2.rows[:, 0]] == [101, 101, 101]
+
 
 class TestRouteStep:
     def test_fused_step(self):

@@ -60,7 +60,8 @@ def relay_listening() -> bool:
                            text=True, timeout=10)
         for ln in r.stdout.splitlines()[1:]:
             cols = ln.split()
-            if len(cols) >= 4 and re.search(r":(808[2-9])$", cols[3]):
+            if len(cols) >= 4 and re.search(r":(808[2-9]|809\d)$",
+                                            cols[3]):
                 return True
         return False
     except Exception:  # noqa: BLE001 — unknown: let the probe decide
