@@ -1288,6 +1288,37 @@ def main():
                             f"{type(e).__name__}: {str(e)[:200]}"
                     finally:
                         signal.alarm(0)
+            if os.environ.get("BENCH_SHARDED", "1") != "0":
+                # multichip serving at scale on a VIRTUAL CPU mesh —
+                # subprocess with the axon pool stripped so it can never
+                # claim (or hang on) the relay; correctness/scale proof,
+                # the chip rows above measure raw speed
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    sp = subprocess.run(
+                        [sys.executable,
+                         os.path.join(os.path.dirname(
+                             os.path.abspath(__file__)),
+                             "tools", "sharded_bench.py")],
+                        capture_output=True, text=True, env=senv,
+                        timeout=int(os.environ.get(
+                            "BENCH_SHARDED_TIMEOUT_S", 1200)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        result["sharded"] = row
+                    else:
+                        result["sharded_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"sharded bench failed: {type(e).__name__}: {e}")
+                    result["sharded_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
             print(json.dumps(result), flush=True)
             return
         except Exception as e:  # noqa: BLE001 — always emit a JSON line

@@ -1209,12 +1209,20 @@ class DeviceRouteEngine:
                                  share=gname)):
                         n += 1
                         metrics.inc("messages.routed.device")
-                    elif self._host_shared_dispatch(f, gname, msg):
-                        # picked member vanished in the in-flight churn
-                        # window: host re-pick over the live members (for
-                        # sticky this is also where affinity re-homes,
+                    else:
+                        # re-dispatch ONLY when the picked member is
+                        # actually gone (in-flight churn window) or the
+                        # ack protocol is on — a nack from a live member
+                        # with dispatch_ack off is final, matching the
+                        # host pick's semantics (for sticky the re-pick
+                        # is also where affinity re-homes,
                         # emqx_shared_sub.erl:269-283)
-                        n += 1
+                        grp = broker.shared.get(f, {}).get(gname)
+                        gone = grp is None or sid not in grp.members
+                        if (gone or broker.shared_dispatch_ack) and \
+                                self._host_shared_dispatch(f, gname,
+                                                           msg):
+                            n += 1
             cluster = broker.cluster
             for f in matched:
                 # groups created after the snapshot on matched filters

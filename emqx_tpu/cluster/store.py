@@ -240,6 +240,9 @@ class ClusterStore:
             self._recv_op(origin, seq, op, table, key, value)
             if i % 1024 == 1023:
                 await asyncio.sleep(0)   # see add_many: loop liveness
+                if self._origin_inc.get(origin) != inc:
+                    return   # origin restarted during the yield: the
+                    # rest of this batch is a dead incarnation's state
 
     # ---- snapshot sync (mnesia copy_table analog) ----
     def _snapshot(self) -> dict:

@@ -734,14 +734,18 @@ class ShardedRouteServer:
                                      share=gname)):
                             n += 1
                             metrics.inc("messages.routed.device")
-                        elif self._host_shared_dispatch(f, gname, msg):
-                            # the picked member vanished between the
-                            # snapshot and this consume (in-flight churn
-                            # window): retry the remaining members
-                            # host-side, like the single-chip engine's
-                            # dirty-slot fallback and the host pick's
-                            # own failover order
-                            n += 1
+                        else:
+                            # re-dispatch only when the picked member
+                            # vanished in the in-flight churn window (or
+                            # the ack protocol is on) — a nack from a
+                            # live member with dispatch_ack off is
+                            # final, matching the host pick
+                            grp = broker.shared.get(f, {}).get(gname)
+                            gone = grp is None or sid not in grp.members
+                            if (gone or broker.shared_dispatch_ack) \
+                                    and self._host_shared_dispatch(
+                                        f, gname, msg):
+                                n += 1
         if not dev_shared:
             n += broker._dispatch_shared(msg, matched)
         elif deep_matched:
