@@ -113,7 +113,7 @@ def main():
 
     # ---- 4. fuse-width sweep (also yields the headline number) -------
     out["fuse_sweep"] = {}
-    for fuse in (1, 2, 4, 8):
+    for fuse in (1, 2, 4, 8, 16):
         stacked = tuple(jnp.stack([staged[k % 8][i] for k in range(fuse)])
                         for i in range(4))
         run = make_window_runner(tables, cursors0, strat, stacked, 4, 2)
