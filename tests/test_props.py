@@ -317,40 +317,122 @@ class TestStoreReplicationProps:
     )
     def test_converges_despite_reorder_dup_stragglers(
             self, ops1, ops2, seed, dup_frac):
-        import random
-
-        store, asyncio = self._mk_store()
-        origin, inc1, inc2 = "n1@x", 1000, 2000
-
-        def frames(inc, ops):
-            return [(origin, inc, i + 1, op, "t", k, v)
+        def as_singles(store, origin, inc, ops):
+            return [(store._h_op, (origin, inc, i + 1, op, "t", k, v))
                     for i, (op, k, v) in enumerate(ops)]
 
-        # deliver inc1 fully (any prefix state is fine — it gets purged),
-        # then a shuffled mix of: ALL inc2 frames, duplicated inc2
-        # frames, and straggler inc1 frames
+        store, origin, want = self._drive_adversarial(
+            ops1, ops2, seed, dup_frac, as_singles)
+        if ops1 or ops2:
+            assert store._applied[origin] == (len(ops2) if ops2
+                                              else len(ops1))
+
+    @classmethod
+    def _drive_adversarial(cls, ops1, ops2, seed, dup_frac, to_msgs):
+        """Shared scaffold: deliver inc1 fully (any prefix state is fine
+        — it gets purged on restart), then a shuffled mix of ALL inc2
+        messages, duplicated inc2 messages, and straggler inc1 messages.
+        `to_msgs(store, origin, inc, ops)` sets the delivery shape
+        (single frames or op_batch chunks). Returns (store, origin,
+        expected latest-incarnation model state)."""
+        import random
+
+        store, asyncio = cls._mk_store()
+        origin, inc1, inc2 = "n1@x", 1000, 2000
         rng = random.Random(seed)
-        mix = frames(inc2, ops2)[:]
-        mix += [f for f in frames(inc2, ops2) if rng.random() < dup_frac]
-        mix += [f for f in frames(inc1, ops1) if rng.random() < 0.5]
+        mix = to_msgs(store, origin, inc2, ops2)[:]
+        mix += [m for m in to_msgs(store, origin, inc2, ops2)
+                if rng.random() < dup_frac]
+        mix += [m for m in to_msgs(store, origin, inc1, ops1)
+                if rng.random() < 0.5]
         rng.shuffle(mix)
 
         async def drive():
-            for f in frames(inc1, ops1):
-                await store._h_op(*f)
-            for f in mix:
-                await store._h_op(*f)
+            for fn, args in to_msgs(store, origin, inc1, ops1):
+                await fn(*args)
+            for fn, args in mix:
+                await fn(*args)
 
         asyncio.run(drive())
-        want = self._model_apply(ops2) if ops2 else (
-            # no inc2 ops ever sent: the replica legitimately still holds
-            # inc1's state (a restart is only observable via its ops)
-            self._model_apply(ops1))
+        # no inc2 ops ever sent: the replica legitimately still holds
+        # inc1's state (a restart is only observable via its ops)
+        want = cls._model_apply(ops2) if ops2 else cls._model_apply(ops1)
+        cls._assert_converged(store, origin, want)
+        return store, origin, want
+
+    @staticmethod
+    def _assert_converged(store, origin, want):
         got = {k: per[origin]
                for k, per in store.table("t").rows.items()
                if origin in per}
         assert {k: sorted(v) for k, v in got.items()} \
             == {k: sorted(v) for k, v in want.items()}
-        if ops1 or ops2:
-            assert store._applied[origin] == (len(ops2) if ops2
-                                              else len(ops1))
+
+    @settings(max_examples=60, deadline=None,
+              suppress_health_check=[HealthCheck.too_slow])
+    @given(
+        ops1=st.lists(st.tuples(st.sampled_from(["add", "del"]),
+                                st.sampled_from(["k1", "k2", "k3"]),
+                                st.integers(0, 4)), max_size=16),
+        ops2=st.lists(st.tuples(st.sampled_from(["add", "del"]),
+                                st.sampled_from(["k1", "k2", "k3"]),
+                                st.integers(0, 4)), max_size=16),
+        chunk=st.integers(1, 6),
+        seed=st.integers(0, 2**32 - 1),
+        dup_frac=st.floats(0, 1),
+    )
+    def test_batched_ops_converge_like_singles(self, ops1, ops2, chunk,
+                                               seed, dup_frac):
+        """store.op_batch (round-5 bulk replication) under the same
+        adversarial delivery as singles: shuffled/duplicated CHUNKS and
+        chunks from a dead incarnation — the replica converges to the
+        latest incarnation's sequential state, and the O(1) count
+        matches the model. (The in-batch restart-abort guard needs a
+        >1024-op batch and is covered by
+        test_batch_aborts_on_restart_mid_yield.)"""
+        def as_batches(store, origin, inc, ops):
+            items = [[i + 1, op, "t", k, v]
+                     for i, (op, k, v) in enumerate(ops)]
+            return [(store._h_op_batch,
+                     (origin, inc, items[i:i + chunk]))
+                    for i in range(0, len(items), chunk)]
+
+        store, origin, want = self._drive_adversarial(
+            ops1, ops2, seed, dup_frac, as_batches)
+        assert store.table("t").count() == \
+            sum(len(v) for v in want.values())
+
+    def test_batch_aborts_on_restart_mid_yield(self):
+        """The in-batch restart guard (store.py _h_op_batch: re-check
+        the origin's incarnation after each 1024-op yield): a newer
+        incarnation landing DURING a large batch's yield must abort the
+        rest of the stale batch — otherwise dead-incarnation rows
+        repopulate the freshly-reset seq buffer and later apply as live
+        state."""
+        import asyncio as aio
+
+        store, _ = self._mk_store()
+        origin = "n1@x"
+        big = [[i + 1, "add", "t", f"k{i}", 0] for i in range(2048)]
+
+        async def drive():
+            task = aio.create_task(store._h_op_batch(origin, 1000, big))
+            await aio.sleep(0)      # let it start and hit the yield
+            # pin the interleave: the batch must have PARTIALLY applied
+            # (reached its first 1024-op yield) before the restart —
+            # otherwise the whole batch would be dropped at entry and
+            # the mid-yield guard would go untested
+            assert store._applied[origin] >= 1024, \
+                store._applied.get(origin)
+            # restart: newer incarnation's first op purges + resets
+            await store._h_op(origin, 2000, 1, "add", "t", "fresh", 7)
+            await task
+
+        aio.run(drive())
+        tab = store.table("t")
+        # nothing from the stale batch may survive the restart purge,
+        # and nothing may sit buffered at old seqs waiting to re-apply
+        assert tab.lookup("fresh") == [(origin, 7)]
+        assert tab.count() == 1, tab.count()
+        assert not store._buffer.get(origin), store._buffer.get(origin)
+        assert store._origin_inc[origin] == 2000
