@@ -24,7 +24,16 @@ broker consumes the device (queue batches, read back deliveries). The
 per-batch sync round-trip is reported separately on stderr.
 
 Env knobs: BENCH_SUBS (default 10_000_000), BENCH_BATCH (131072),
-BENCH_WINDOW (32), BENCH_SHARED_PCT (50), BENCH_PUT_CHUNK_MB (64).
+BENCH_WINDOW (32), BENCH_SHARED_PCT (50), BENCH_PUT_CHUNK_MB (64),
+EMQX_TPU_RELAY_WAIT_S (dead-relay fail-fast window, default
+BENCH_INIT_TIMEOUT_S=600 — set it low to stop burning a round's budget
+polling a relay that never comes up).
+
+Diagnosability: every e2e phase snapshots the node's pipeline telemetry
+(stage timings, batch occupancy, compile counts —
+broker.telemetry.PipelineTelemetry.snapshot()) into the result row, and
+the newest snapshot is embedded in the error JSON too, so a round that
+dies mid-flight still reports WHERE the pipeline spent its time.
 """
 
 import json
@@ -38,6 +47,12 @@ import numpy as np
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+# newest pipeline-telemetry snapshot taken this run (set by run_e2e,
+# success or failure) — embedded in the error JSON so a round that died
+# after real traffic still carries its stage-level diagnosis
+_LAST_TELEMETRY = None
 
 
 def _last_measured():
@@ -81,6 +96,8 @@ def _error_json(error) -> str:
         doc["note"] = ("this run failed environmentally; last_measured is "
                        "the committed mid-round hardware result "
                        "(MEASURED_r05.json)")
+    if _LAST_TELEMETRY:
+        doc["telemetry"] = _LAST_TELEMETRY
     return json.dumps(doc)
 
 
@@ -888,6 +905,8 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
     """
     import asyncio
 
+    node_box: dict = {}
+
     async def go():
         from emqx_tpu.broker.connection import Listener
         from emqx_tpu.broker.node import Node
@@ -899,7 +918,7 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
         wus = os.environ.get("BENCH_WINDOW_US")
         if wus:
             conf = {"broker": {"batch_window_us": int(wus)}}
-        node = Node(conf or None, use_device=use_device)
+        node = node_box["node"] = Node(conf or None, use_device=use_device)
         lst = Listener(node, bind="127.0.0.1", port=0)
         await lst.start()
         from emqx_tpu.mqtt import packet as P
@@ -1114,6 +1133,13 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             if measured:
                 out_extra["best_window_us"] = min(
                     measured, key=lambda r: r["lat_p99_ms"])["window_us"]
+        # per-stage pipeline telemetry: stage p50/p95/p99, batch
+        # occupancy per shape class, compile accounting — one schema
+        # shared with GET /api/v5/pipeline/stats and profile_step.py
+        try:
+            out_extra["telemetry"] = node.pipeline_telemetry.snapshot()
+        except Exception as e:  # noqa: BLE001 — diagnosis must not kill data
+            log(f"telemetry snapshot failed: {type(e).__name__}: {e}")
         return {
             "delivered": delivered,
             "sent": total,
@@ -1143,7 +1169,18 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             if jitter else None,
         }
 
-    return asyncio.run(go())
+    global _LAST_TELEMETRY
+    try:
+        return asyncio.run(go())
+    finally:
+        # success or crash, keep the newest snapshot for the error JSON:
+        # "relay never came up"-class failures stay diagnosable
+        node = node_box.get("node")
+        if node is not None:
+            try:
+                _LAST_TELEMETRY = node.pipeline_telemetry.snapshot()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def main():
@@ -1170,7 +1207,13 @@ def main():
     # wait is skipped.
     import subprocess
 
-    init_budget = int(os.environ.get("BENCH_INIT_TIMEOUT_S", 600))
+    # dead-relay fail-fast: EMQX_TPU_RELAY_WAIT_S bounds how long the
+    # round may poll for a relay window before reporting (BENCH_r05 spent
+    # ~9 blind minutes on "relay never came up"; now the window is an
+    # explicit, tunable budget and the error JSON carries telemetry)
+    init_budget = int(os.environ.get(
+        "EMQX_TPU_RELAY_WAIT_S",
+        os.environ.get("BENCH_INIT_TIMEOUT_S", 600)))
     deadline = time.time() + init_budget
     axon = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) and \
         "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower()
@@ -1302,6 +1345,11 @@ def main():
                         traceback.print_exc(file=sys.stderr)
                         result[f"{name}_error"] = \
                             f"{type(e).__name__}: {str(e)[:200]}"
+                        if _LAST_TELEMETRY:
+                            # the failed phase's pipeline snapshot: the
+                            # stage-level diagnosis the round would
+                            # otherwise lose
+                            result[f"{name}_telemetry"] = _LAST_TELEMETRY
                     finally:
                         signal.alarm(0)
             if os.environ.get("BENCH_SHARDED", "1") != "0":

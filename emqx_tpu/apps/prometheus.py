@@ -21,21 +21,60 @@ def _san(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
 
 
-def collect(node) -> str:
-    """Render the node's counters/gauges in text exposition format."""
-    out: list[str] = []
+def _lbl(value: str) -> str:
+    """Escape a label VALUE per the exposition format (backslash first,
+    then quote and newline)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
-    def emit(name: str, value, kind: str = "counter",
-             help_: str = "") -> None:
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus `le` label rendering: +Inf for the overflow bucket,
+    shortest-repr floats otherwise."""
+    if bound == float("inf"):
+        return "+Inf"
+    return repr(bound)
+
+
+def collect(node) -> str:
+    """Render the node's counters/gauges/histograms in text exposition
+    format. Each metric family declares `# TYPE` exactly once (a family
+    with several samples — labeled rule metrics, histogram bucket
+    series — shares the one declaration), histogram buckets are
+    cumulative and end in `+Inf`, and label values are escaped."""
+    out: list[str] = []
+    declared: set[str] = set()
+
+    def declare(name: str, kind: str, help_: str = "") -> None:
+        if name in declared:
+            return
+        declared.add(name)
         if help_:
             out.append(f"# HELP {name} {help_}")
         out.append(f"# TYPE {name} {kind}")
+
+    def emit(name: str, value, kind: str = "counter",
+             help_: str = "") -> None:
+        declare(name, kind, help_)
         out.append(f"{name} {value}")
 
     for name, val in sorted(node.metrics.all().items()):
         emit(f"emqx_{_san(name)}", val, "counter")
     for name, val in sorted(node.stats.sample().items()):
         emit(f"emqx_{_san(name)}", val, "gauge")
+    # pipeline (and any other) histograms: _bucket{le}/_sum/_count series
+    for name, h in sorted(node.metrics.histograms().items()):
+        fam = f"emqx_{_san(name)}"
+        declare(fam, "histogram")
+        # one cumulative() pass is the scrape's consistent view: _count
+        # must equal the +Inf bucket even when an executor thread
+        # observes mid-collect (reading h.count separately could exceed
+        # the bucket series and fail ingester consistency checks)
+        cum = h.cumulative()
+        for bound, c in cum:
+            out.append(f'{fam}_bucket{{le="{_fmt_le(bound)}"}} {c}')
+        out.append(f"{fam}_sum {h.sum}")
+        out.append(f"{fam}_count {cum[-1][1]}")
     ru = resource.getrusage(resource.RUSAGE_SELF)
     emit("emqx_vm_used_memory_kb", ru.ru_maxrss, "gauge",
          "resident set size")
@@ -43,10 +82,18 @@ def collect(node) -> str:
          round(ru.ru_utime + ru.ru_stime, 3), "counter")
     eng = getattr(node, "rule_engine", None)
     if eng is not None:
+        # group by FAMILY first: the exposition format requires all
+        # samples of one family consecutive under its single TYPE line
+        # (per-rule emission interleaved families when >1 rule existed)
+        fams: dict[str, list[str]] = {}
         for r in eng.list_rules():
-            rid = _san(r.id)
+            rid = _lbl(_san(r.id))
             for k, v in r.metrics.counters.items():
-                out.append(f'emqx_rule_{_san(k)}{{rule="{rid}"}} {v}')
+                fams.setdefault(f"emqx_rule_{_san(k)}", []).append(
+                    f'{{rule="{rid}"}} {v}')
+        for fam in sorted(fams):
+            declare(fam, "counter")
+            out.extend(fam + s for s in fams[fam])
     return "\n".join(out) + "\n"
 
 

@@ -1,7 +1,13 @@
 """StatsD exporter.
 
 Parity: apps/emqx_statsd — periodic UDP push of broker metrics (counters
-as deltas `|c`) and stats (gauges `|g`) to a StatsD daemon.
+as deltas `|c`) and stats (gauges `|g`) to a StatsD daemon. Pipeline
+latency histograms ride as `|ms` timers: each flush sends the interval's
+mean latency with a StatsD sample rate of 1/new_observations, so the
+daemon reconstructs both magnitude and volume without one packet per
+observation; ratio histograms (batch occupancy) flush as interval-mean
+gauges. The final interval flushes on `unload()` — a stopping node no
+longer silently drops its last deltas.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ class StatsdApp:
         self.interval = c.get("interval", 10.0)
         self.batch_bytes = c.get("batch_bytes", 1400)
         self._last: dict[str, int] = {}
+        self._last_hist: dict[str, tuple[int, float]] = {}
         self._task: Optional[asyncio.Task] = None
         self._sock: Optional[socket.socket] = None
 
@@ -39,6 +46,12 @@ class StatsdApp:
         if self._task:
             self._task.cancel()
         if self._sock:
+            try:
+                # final flush: the deltas accumulated since the last
+                # interval tick must not vanish when the node stops
+                self.flush()
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("statsd final flush failed: %s", e)
             self._sock.close()
             self._sock = None
         if getattr(self.node, "statsd", None) is self:
@@ -54,6 +67,26 @@ class StatsdApp:
                 lines.append(f"{self.prefix}.{name}:{delta}|c")
         for name, val in sorted(self.node.stats.sample().items()):
             lines.append(f"{self.prefix}.{name}:{val}|g")
+        # histograms: latency-unit ones (pipeline stage spans) as |ms
+        # timers — one sampled line per flush carrying the interval mean
+        # with rate=1/new_count, so aggregate latency AND volume survive
+        # the UDP budget (StatsD's documented sampling semantics);
+        # ratio-unit ones (batch occupancy) as interval-mean gauges
+        for name, h in sorted(self.node.metrics.histograms().items()):
+            lc, ls = self._last_hist.get(name, (0, 0.0))
+            dc, ds = h.count - lc, h.sum - ls
+            self._last_hist[name] = (h.count, h.sum)
+            if dc <= 0:
+                continue
+            if h.unit == "seconds":
+                # clamp: >2M observations per interval would render as
+                # the invalid zero rate |@0.000000
+                rate = f"|@{max(1.0 / dc, 1e-6):.6f}" if dc > 1 else ""
+                lines.append(
+                    f"{self.prefix}.{name}:{ds / dc * 1000.0:.3f}|ms"
+                    f"{rate}")
+            else:
+                lines.append(f"{self.prefix}.{name}:{ds / dc:.4f}|g")
         return lines
 
     def flush(self) -> int:

@@ -63,6 +63,28 @@ class SysBroker:
             self._pub(f"stats/{name}", str(val).encode())
         for name, val in self.node.metrics.all().items():
             self._pub(f"metrics/{name}", str(val).encode())
+        self.publish_pipeline()
+
+    def publish_pipeline(self) -> None:
+        """$SYS/brokers/<node>/pipeline/# — the device-path telemetry
+        snapshot, piecewise: one JSON payload per stage
+        (`pipeline/stages/<stage>`), per occupancy class
+        (`pipeline/occupancy/<class>`), plus `pipeline/compiles` and
+        `pipeline/decisions`."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        if tele is None:
+            return
+        snap = tele.snapshot()
+        for stage, row in snap["stages"].items():
+            self._pub(f"pipeline/stages/{stage}",
+                      json.dumps(row).encode())
+        for cls, row in snap["occupancy"].items():
+            self._pub(f"pipeline/occupancy/{cls}",
+                      json.dumps(row).encode())
+        self._pub("pipeline/compiles",
+                  json.dumps(snap["compiles"]).encode())
+        self._pub("pipeline/decisions",
+                  json.dumps(snap["decisions"]).encode())
 
     # ---- alarms → $SYS ----
     def on_alarm_activated(self, alarm: dict) -> None:

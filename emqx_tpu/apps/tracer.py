@@ -5,12 +5,21 @@ Level, File)` installs a filtered handler capturing matching publish and
 client lifecycle events (emqx_tracer.erl:66-75+); `stop_trace` removes it,
 `lookup_traces` lists active traces. The OTP-logger-filter mechanism
 becomes hook callbacks writing formatted lines.
+
+Slow-batch tracing: pipeline telemetry fires the `batch.slow` hook when a
+publish batch's oldest-enqueue→completion span exceeds the configurable
+`broker.slow_batch_threshold_ms`; the tracer logs every such event at
+WARNING and mirrors it into any `start_trace("slow_batch", ...)` files —
+the stage-level flight recorder a dead bench round needs.
 """
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Optional, TextIO
+
+log = logging.getLogger("emqx_tpu.tracer")
 
 from emqx_tpu.broker.message import Message
 from emqx_tpu.utils import topic as T
@@ -18,7 +27,7 @@ from emqx_tpu.utils import topic as T
 
 class Trace:
     def __init__(self, kind: str, value: str, path: str):
-        if kind not in ("clientid", "topic"):
+        if kind not in ("clientid", "topic", "slow_batch"):
             raise ValueError(f"bad trace kind {kind!r}")
         self.kind = kind
         self.value = value
@@ -26,6 +35,8 @@ class Trace:
         self._fh: Optional[TextIO] = open(path, "a")
 
     def matches_msg(self, msg: Message) -> bool:
+        if self.kind == "slow_batch":
+            return False
         if self.kind == "clientid":
             return msg.from_ == self.value
         return T.match(msg.topic, self.value)
@@ -58,11 +69,13 @@ class Tracer:
         h.add("client.disconnected", self.on_client_disconnected,
               tag="tracer")
         h.add("session.subscribed", self.on_session_subscribed, tag="tracer")
+        h.add("batch.slow", self.on_batch_slow, tag="tracer")
         return self
 
     def unload(self) -> None:
         for hp in ("message.publish", "client.connected",
-                   "client.disconnected", "session.subscribed"):
+                   "client.disconnected", "session.subscribed",
+                   "batch.slow"):
             self.node.hooks.delete(hp, "tracer")
         for t in self._traces.values():
             t.close()
@@ -109,6 +122,17 @@ class Tracer:
         for t in self._traces.values():
             if t.matches_client(cid):
                 t.write(f"DISCONNECTED clientid={cid} reason={reason}")
+
+    def on_batch_slow(self, info: dict) -> None:
+        """`batch.slow` hook (broker.telemetry.record_total): a publish
+        batch exceeded the slow-batch threshold — always logged, and
+        mirrored into active slow_batch trace files."""
+        line = ("SLOW_BATCH " +
+                " ".join(f"{k}={info[k]}" for k in sorted(info)))
+        log.warning("%s", line)
+        for t in self._traces.values():
+            if t.kind == "slow_batch":
+                t.write(line)
 
     def on_session_subscribed(self, clientinfo: dict, topic: str,
                               subopts: dict) -> None:

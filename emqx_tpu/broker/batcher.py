@@ -58,6 +58,9 @@ class PublishBatcher:
                  window_fuse: int = 8):
         self.node = node
         self.engine = engine
+        # pipeline telemetry (stage spans / occupancy / decisions) — a
+        # Node always carries one; tolerate bare test harness nodes
+        self.tele = getattr(node, "pipeline_telemetry", None)
         self.window_s = window_us / 1e6
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
@@ -188,6 +191,11 @@ class PublishBatcher:
                     while self._queue and len(batch) < limit:
                         batch.append(self._queue.popleft())
                         self._q_times.popleft()
+                    if self.tele is not None:
+                        # enqueue stage: oldest-message queue wait before
+                        # its batch formed (upper-bounds the batch)
+                        self.tele.observe_stage(
+                            "enqueue", time.perf_counter() - t_enq)
                     return {"batch": batch, "handle": None, "sub": 0,
                             "dispatch_fut": None, "live": None,
                             "live_idx": None, "t_enq": t_enq}
@@ -273,6 +281,18 @@ class PublishBatcher:
                                     self.engine.dispatch, handle)
                     if not dispatched:
                         self._since_probe += 1
+                    if self.tele is not None:
+                        if dispatched:
+                            self.tele.record_decision("device", len(lives))
+                        else:
+                            # a fused group can fall back whole (e.g.
+                            # prepare_window returned None mid-rebuild):
+                            # every entry in it is a host batch
+                            self.tele.record_decision("host", len(group))
+                            for e in group:
+                                self.tele.record_occupancy(
+                                    "host",
+                                    len(e["batch"]) / self.max_batch)
                 except asyncio.CancelledError:
                     for e in group:
                         self._fail_entry(
@@ -332,6 +352,7 @@ class PublishBatcher:
 
     async def _fold_hooks(self, entry: dict) -> None:
         """message.publish hook fold, concurrently across the batch."""
+        t0 = time.perf_counter()
         broker = self.node.broker
         batch = entry["batch"]
         folded = await asyncio.gather(*[
@@ -347,6 +368,9 @@ class PublishBatcher:
             live.append(m)
         entry["live"] = live
         entry["live_idx"] = live_idx
+        if self.tele is not None:
+            self.tele.observe_stage("batch_form",
+                                    time.perf_counter() - t0)
 
     # ---- consumer: complete batches strictly in order --------------------
     async def _complete_host(self, entry: dict, routed=None) -> None:
@@ -359,6 +383,8 @@ class PublishBatcher:
         sequential."""
         batch = entry["batch"]
         counts = [0] * len(batch)
+        tele = self.tele
+        path = "host" if routed is None else "device"
         try:
             if "error" in entry:
                 raise entry["error"]
@@ -368,13 +394,24 @@ class PublishBatcher:
                 routed = []
                 broker = self.node.broker
                 for j, m in enumerate(live):
-                    routed.append(
-                        broker._route(m, broker.router.match(m.topic)))
+                    if tele is not None and j % 32 == 0:
+                        # sampled host match split: the host-side
+                        # decomposition of the device program's match
+                        # stage (1-in-32 keeps the hot loop cheap)
+                        tm = time.perf_counter()
+                        mt = broker.router.match(m.topic)
+                        tele.observe_stage("host_match",
+                                           time.perf_counter() - tm)
+                    else:
+                        mt = broker.router.match(m.topic)
+                    routed.append(broker._route(m, mt))
                     if j % 64 == 63:
                         await asyncio.sleep(0)
+                span = time.perf_counter() - t0
+                if tele is not None:
+                    tele.observe_stage("host_route", span)
                 self._host_msg_s, self._host_spike = _ewma(
-                    self._host_msg_s,
-                    (time.perf_counter() - t0) / len(live),
+                    self._host_msg_s, span / len(live),
                     self._host_spike)
                 # a host completion breaks the device completion chain:
                 # the next device sample must be a full round-trip, not
@@ -391,7 +428,10 @@ class PublishBatcher:
             # path funnels through here with `routed` precomputed)
             t_enq = entry.get("t_enq")
             if t_enq is not None:
-                self.route_lat.append(time.perf_counter() - t_enq)
+                total = time.perf_counter() - t_enq
+                self.route_lat.append(total)
+                if tele is not None:
+                    tele.record_total(total, batch=len(batch), path=path)
         except Exception as e:  # route failure must not hang publishers
             for _m, fut in batch:
                 if fut is not None and not fut.done():

@@ -62,6 +62,7 @@ until the next rebuild.
 
 from __future__ import annotations
 
+import time
 import zlib
 from typing import Optional
 
@@ -590,34 +591,40 @@ class DeviceRouteEngine:
         first-use of a bigger class stalls serving on an XLA
         trace/compile (tracing holds the GIL even on an executor thread;
         cached compiles don't)."""
+        import contextlib
+
         import jax
 
         from emqx_tpu.models.router_engine import (route_step,
                                                    route_window_full)
         from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+        tele = getattr(self.node, "pipeline_telemetry", None)
         b, tables, cursors, _rich = result
         strat = np.int32(STRATEGY_ROUND_ROBIN)
         for Wp, Bp in self._STD_CLASSES:
             if Wp > 1 and b.backend != "shapes":
                 continue    # trie backend never fuses: (8, Bp) would
                             # just redundantly re-run the (1, Bp) step
+            ctx = tele.compile_context(f"warm W{Wp}xB{Bp}") \
+                if tele is not None else contextlib.nullcontext()
             enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
             lens = np.zeros((Wp, Bp), np.int32)
             dollar = np.zeros((Wp, Bp), bool)
             mh = np.zeros((Wp, Bp), np.int32)
-            if b.backend == "shapes":
-                r = route_window_full(tables, cursors, enc, lens, dollar,
-                                      mh, strat,
-                                      fanout_cap=self.fanout_cap,
-                                      slot_cap=self.slot_cap)
-            else:
-                r = route_step(tables, cursors, enc[0], lens[0],
-                               dollar[0], mh[0], strat,
-                               frontier_cap=self.frontier_cap,
-                               match_cap=self.match_cap,
-                               fanout_cap=self.fanout_cap,
-                               slot_cap=self.slot_cap)
-            jax.block_until_ready(r.match_counts)
+            with ctx:
+                if b.backend == "shapes":
+                    r = route_window_full(tables, cursors, enc, lens,
+                                          dollar, mh, strat,
+                                          fanout_cap=self.fanout_cap,
+                                          slot_cap=self.slot_cap)
+                else:
+                    r = route_step(tables, cursors, enc[0], lens[0],
+                                   dollar[0], mh[0], strat,
+                                   frontier_cap=self.frontier_cap,
+                                   match_cap=self.match_cap,
+                                   fanout_cap=self.fanout_cap,
+                                   slot_cap=self.slot_cap)
+                jax.block_until_ready(r.match_counts)
         if b.backend == "shapes":
             # this snapshot's classes are warm: once IT is serving, the
             # batcher may dispatch/fuse (readiness is per shape
@@ -740,20 +747,27 @@ class DeviceRouteEngine:
         tables, cursors = self._tables, self._cursors
         sig = self._cur_sig
 
+        tele = getattr(self.node, "pipeline_telemetry", None)
+
         def warm():
+            import contextlib
+
             import jax
 
             from emqx_tpu.models.router_engine import route_window_full
             from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
             strat = np.int32(STRATEGY_ROUND_ROBIN)
             for Wp, Bp in missing:
+                ctx = tele.compile_context(f"warm W{Wp}xB{Bp}") \
+                    if tele is not None else contextlib.nullcontext()
                 enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
                 z = np.zeros((Wp, Bp), np.int32)
-                r = route_window_full(
-                    tables, cursors, enc, z, np.zeros((Wp, Bp), bool),
-                    z, strat, fanout_cap=self.fanout_cap,
-                    slot_cap=self.slot_cap)
-                jax.block_until_ready(r.match_counts)
+                with ctx:
+                    r = route_window_full(
+                        tables, cursors, enc, z, np.zeros((Wp, Bp), bool),
+                        z, strat, fanout_cap=self.fanout_cap,
+                        slot_cap=self.slot_cap)
+                    jax.block_until_ready(r.match_counts)
                 self._warm_classes.add((sig, Wp, Bp))
 
         async def run():
@@ -822,6 +836,16 @@ class DeviceRouteEngine:
         self._outstanding += 1
         self.node.metrics.inc("routing.device.windows")
         self.node.metrics.inc("routing.device.window_subs", W)
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        if tele is not None:
+            # batch occupancy per shape class: how much of the padded
+            # (Wp, Bp) program each dispatch actually fills — low fill
+            # means padding compute dominates (shrink the window /
+            # batch class), high fill means the class is saturated
+            for msgs in lives:
+                tele.record_occupancy(f"b{Bp}", len(msgs) / Bp)
+            if Wp > 1:
+                tele.record_occupancy(f"w{Wp}", W / Wp)
         return h
 
     # ---- device-side tracing (SURVEY §5.1 mapping) -------------------
@@ -851,7 +875,24 @@ class DeviceRouteEngine:
         """Stage 2 (executor thread): run the jitted route step. On a
         dispatch relay this blocks on HTTP; on co-located hardware it is an
         async enqueue — either way it is off the event loop. Under an
-        active jax.profiler trace every dispatch is one annotated step."""
+        active jax.profiler trace every dispatch is one annotated step.
+        The span lands in the `dispatch` stage histogram; any jit-cache
+        miss inside it is attributed to this window's (W, B) class as an
+        IN-PATH recompile (the kind the warm gates exist to prevent)."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
+        try:
+            if tele is not None:
+                Wp, Bp = h.enc[0].shape[0], h.enc[0].shape[1]
+                with tele.compile_context(f"dispatch W{Wp}xB{Bp}"):
+                    self._dispatch_annotated(h)
+            else:
+                self._dispatch_annotated(h)
+        finally:
+            if tele is not None:
+                tele.observe_stage("dispatch", time.perf_counter() - t0)
+
+    def _dispatch_annotated(self, h) -> None:
         if getattr(self, "_tracing", False):
             import jax
             self._step_num = getattr(self, "_step_num", 0) + 1
@@ -917,11 +958,15 @@ class DeviceRouteEngine:
     def materialize(self, h) -> None:
         """Stage 3 (executor thread): blocking device→host readbacks.
         Every field is [W, ...] (window-stacked)."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
         res = h.res
         h.np_res = (np.asarray(res.matches), np.asarray(res.rows),
                     np.asarray(res.opts), np.asarray(res.shared_sids),
                     np.asarray(res.shared_rows), np.asarray(res.shared_opts),
                     np.asarray(res.overflow), np.asarray(res.occur))
+        if tele is not None:
+            tele.observe_stage("materialize", time.perf_counter() - t0)
 
     def finish_sub(self, h, k: int) -> list[int]:
         """Stage 4 (event loop): consume sub-batch k of the window into
@@ -936,6 +981,8 @@ class DeviceRouteEngine:
         device unable to win e2e no matter how fast the chip was.
         Messages the fast path can't prove clean fall through to
         _consume_one unchanged."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
         try:
             (matches, rows, opts, shared_sids, shared_rows, shared_opts,
              overflow, occur) = h.np_res
@@ -967,6 +1014,8 @@ class DeviceRouteEngine:
             metrics.inc("routing.device.batches")
             return counts
         finally:
+            if tele is not None:
+                tele.observe_stage("deliver", time.perf_counter() - t0)
             self._release_one(h)
 
     def _consume_batch_fast(self, msgs, m_k, r_k, o_k, ss_k, too_long,

@@ -11,6 +11,7 @@ gets from `counters:add` — wait-free increments on the hot path.
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from typing import Callable, Optional
@@ -72,9 +73,95 @@ ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS +
                AUTHZ_METRICS)
 
 
+class Histogram:
+    """Fixed log2-bucket histogram with wait-free increments.
+
+    Bucket bounds are `lo * 2**i` for i in [0, n_buckets); an observation
+    lands in the first bucket whose bound is >= the value (values <= lo —
+    including 0 — land in bucket 0; values beyond the last bound land in
+    the overflow bucket, visible only as the +Inf series). Increments are
+    a frexp + two int adds under the GIL — the same practical wait-free
+    property as the plain counters (emqx_metrics' counters:add analog;
+    the bucket layout mirrors prometheus.erl's default log-scale
+    histogram support).
+    """
+
+    __slots__ = ("name", "unit", "lo", "bounds", "counts", "sum", "count")
+
+    def __init__(self, name: str, *, lo: float = 1e-6,
+                 n_buckets: int = 28, unit: str = "seconds"):
+        self.name = name
+        self.unit = unit
+        self.lo = lo
+        self.bounds = [lo * (1 << i) for i in range(n_buckets)]
+        self.counts = [0] * (n_buckets + 1)    # [-1] is overflow (+Inf)
+        self.sum = 0.0
+        self.count = 0
+
+    def _index(self, v: float) -> int:
+        if v <= self.lo:
+            return 0
+        m, e = math.frexp(v / self.lo)     # v/lo = m * 2^e, m in [0.5, 1)
+        i = e - 1 if m == 0.5 else e       # smallest i with v <= lo*2^i
+        return min(i, len(self.bounds))    # beyond last bound -> overflow
+
+    def observe(self, v: float) -> None:
+        self.counts[self._index(v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """Prometheus-shaped (le, cumulative_count) pairs; the final
+        entry is (+Inf, total count)."""
+        out = []
+        acc = 0
+        for b, c in zip(self.bounds, self.counts):
+            acc += c
+            out.append((b, acc))
+        out.append((math.inf, acc + self.counts[-1]))
+        return out
+
+    def percentile(self, p: float) -> float:
+        """Upper bucket bound at quantile p (0..1) — an over-estimate by
+        at most one log2 step. Overflow observations clamp to twice the
+        last finite bound (keeps snapshots JSON-finite)."""
+        if self.count == 0:
+            return 0.0
+        want = p * self.count
+        acc = 0
+        for b, c in zip(self.bounds, self.counts):
+            acc += c
+            if acc >= want:
+                return b
+        return 2 * self.bounds[-1]
+
+    def snapshot(self) -> dict:
+        n = self.count
+        return {
+            "count": n,
+            "sum": round(self.sum, 6),
+            "mean": round(self.sum / n, 9) if n else 0.0,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+
 class Metrics:
     def __init__(self):
         self._c: dict[str, int] = {name: 0 for name in ALL_METRICS}
+        self._h: dict[str, Histogram] = {}
+
+    def hist(self, name: str, **kw) -> Histogram:
+        """Get-or-create a named histogram (exported by every exporter
+        alongside the counters)."""
+        h = self._h.get(name)
+        if h is None:
+            h = self._h[name] = Histogram(name, **kw)
+        return h
+
+    def histograms(self) -> dict[str, Histogram]:
+        return dict(self._h)
 
     def inc(self, name: str, n: int = 1) -> None:
         try:

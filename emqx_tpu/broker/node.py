@@ -40,6 +40,12 @@ class Node:
             # default-on: the fused device route step IS the serving path
             # wherever a jax device exists (real TPU or the CPU backend)
             use_device = bool(perf.get("device_route", True))
+        from emqx_tpu.broker.telemetry import PipelineTelemetry
+        slow_ms = perf.get("slow_batch_threshold_ms", 250)
+        self.pipeline_telemetry = PipelineTelemetry(
+            self.metrics, hooks=self.hooks,
+            slow_batch_s=(slow_ms / 1000.0) if slow_ms else None,
+            track_compiles=use_device)
         self.router = Router(
             use_device=use_device,
             rebuild_threshold=perf.get("rebuild_threshold", 256),

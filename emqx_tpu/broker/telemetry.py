@@ -1,0 +1,271 @@
+"""Device-path pipeline telemetry: stage spans, occupancy, compiles.
+
+The observability substrate for the batched PUBLISH pipeline (the
+reference's layer-0 emqx_metrics/emqx_stats/emqx_tracer triplet, grown a
+dimension: per-STAGE latency attribution instead of counters alone).
+`PipelineTelemetry` owns log2-bucket histograms (broker.metrics.Histogram)
+for every pipeline stage —
+
+    enqueue      oldest-message wait in the submit queue before its batch
+                 forms (broker/batcher._produce)
+    batch_form   message.publish hook fold + live-filter per batch
+    dispatch     the jitted route step, executor-thread wall time (on a
+                 dispatch relay this is the HTTP round trip; match +
+                 fan-out + shared picks all run inside it on device)
+    materialize  device->host readbacks
+    deliver      RouteResult consumption into session deliveries
+    host_route   host-path match + route span for host-routed batches
+    host_match   per-message host trie match latency (sampled 1-in-32 —
+                 the host-side decomposition of dispatch's match stage)
+    total        oldest-enqueue -> batch completion (the reservoir
+                 lat_percentiles() draws from, now exportable)
+
+— plus batch-occupancy histograms per device shape class (fill fraction
+of the padded (W, Bp) program each dispatch actually used) and JIT
+compile/recompile accounting fed by jax.monitoring: every jit-cache miss
+(jaxpr trace) under an instrumented span counts as one compile event,
+attributed to the (W, Bp) class that triggered it, with trace + lowering
++ backend-compile durations accumulated.
+
+Everything lands in the node's Metrics registry, so the Prometheus,
+StatsD and $SYS exporters pick the histograms up with zero coupling to
+this module; `snapshot()` is the JSON schema shared by
+`GET /api/v5/pipeline/stats`, bench.py's embedded telemetry and
+`tools/profile_step.py --telemetry-out`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from emqx_tpu.broker.metrics import Metrics
+
+SCHEMA = "emqx_tpu.pipeline/v1"
+
+STAGES = ("enqueue", "batch_form", "dispatch", "materialize", "deliver",
+          "host_route", "host_match", "total")
+
+# stage histograms: 1us .. ~134s in 28 log2 buckets
+_STAGE_LO, _STAGE_BUCKETS = 1e-6, 28
+# occupancy histograms: fill fraction 1/256 .. 1.0 in 9 log2 buckets
+_OCC_LO, _OCC_BUCKETS = 1.0 / 256, 9
+
+# ---- process-wide jax.monitoring listener --------------------------------
+# ONE listener per process (jax.monitoring has no deregistration). A
+# compile event is attributed to the instance whose compile_context() is
+# active on the FIRING thread — jit traces/compiles run on the thread
+# that called the jitted function, so the dispatch/warm spans in the
+# engines scope attribution exactly; events outside any span are ignored
+# (they belong to no pipeline).
+_tls = threading.local()
+_listener_installed = False
+_install_lock = threading.Lock()
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENTS = (
+    _TRACE_EVENT,
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+    "/jax/core/compile/backend_compile_duration",
+)
+
+
+def _on_jax_event(name: str, dur: float, **_kw) -> None:
+    if name not in _COMPILE_EVENTS:
+        return
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return
+    tele, shape = ctx
+    tele._note_compile_event(shape, dur, is_trace=(name == _TRACE_EVENT))
+
+
+def _install_listener() -> bool:
+    global _listener_installed
+    with _install_lock:
+        if _listener_installed:
+            return True
+        try:
+            import jax.monitoring as M
+            M.register_event_duration_secs_listener(_on_jax_event)
+        except Exception:  # noqa: BLE001 — no jax / ancient jax: no-op
+            return False
+        _listener_installed = True
+        return True
+
+
+class PipelineTelemetry:
+    """Per-node (or standalone) pipeline telemetry registry.
+
+    Node wires one up as `node.pipeline_telemetry`; tools/profile_step
+    builds a standalone one around its own Metrics. All hot-path entry
+    points are plain histogram observes — no locks, no allocation beyond
+    the first observation of a new occupancy class.
+    """
+
+    def __init__(self, metrics: Optional[Metrics] = None, *,
+                 hooks=None, slow_batch_s: Optional[float] = None,
+                 track_compiles: bool = True):
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.hooks = hooks
+        # slow-batch watch: a total span beyond this fires the
+        # `batch.slow` hook (apps/tracer writes the log line) and counts
+        # pipeline.slow_batches. None disables.
+        self.slow_batch_s = slow_batch_s
+        self.started_at = time.time()
+        self._compiles_lock = threading.Lock()
+        self.compiles = 0            # jit-cache misses (trace events)
+        self.compile_s = 0.0         # trace + lowering + backend time
+        self.compiles_by_shape: dict[str, dict] = {}
+        if track_compiles:
+            _install_listener()
+        for s in STAGES:
+            self._stage_hist(s)
+
+    # ---- stage spans -----------------------------------------------------
+    def _stage_hist(self, stage: str):
+        return self.metrics.hist(f"pipeline.stage.{stage}.seconds",
+                                 lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        self._stage_hist(stage).observe(seconds)
+
+    @contextlib.contextmanager
+    def stage(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_stage(stage, time.perf_counter() - t0)
+
+    def record_total(self, seconds: float, **meta) -> None:
+        """The end-of-batch span: feeds the `total` histogram and the
+        slow-batch watch (threshold -> batch.slow hook + counter)."""
+        self.observe_stage("total", seconds)
+        if self.slow_batch_s is not None and seconds > self.slow_batch_s:
+            self.metrics.inc("pipeline.slow_batches")
+            if self.hooks is not None:
+                self.hooks.run("batch.slow",
+                               (dict(meta, duration_ms=round(
+                                   seconds * 1000, 3)),))
+
+    # ---- occupancy -------------------------------------------------------
+    def record_occupancy(self, cls: str, fill: float) -> None:
+        """Fill fraction of one dispatched batch within its padded shape
+        class (`b{Bp}` for single batches, `w{Wp}` for fused-window
+        width, `host` for host-routed batches vs max_batch)."""
+        self.metrics.hist(f"pipeline.occupancy.{cls}",
+                          lo=_OCC_LO, n_buckets=_OCC_BUCKETS,
+                          unit="ratio").observe(fill)
+
+    # ---- routing decisions ----------------------------------------------
+    def record_decision(self, path: str, n: int = 1) -> None:
+        """Formed batches' device/host routing outcome
+        (`device` | `host` — the finer-grained reasons keep their
+        existing routing.device.* counters)."""
+        self.metrics.inc(f"pipeline.batches.{path}", n)
+
+    # ---- compile accounting ---------------------------------------------
+    @contextlib.contextmanager
+    def compile_context(self, shape: str):
+        """Scope jit compile attribution to `shape` (e.g. "W8xB1024") on
+        the current thread. Every jit-cache miss inside the span counts
+        as one compile event for that shape."""
+        prev = getattr(_tls, "ctx", None)
+        _tls.ctx = (self, shape)
+        try:
+            yield
+        finally:
+            _tls.ctx = prev
+
+    def _note_compile_event(self, shape: str, dur: float,
+                            is_trace: bool) -> None:
+        with self._compiles_lock:
+            row = self.compiles_by_shape.setdefault(
+                shape, {"count": 0, "total_s": 0.0})
+            row["total_s"] += dur
+            self.compile_s += dur
+            if is_trace:
+                row["count"] += 1
+                self.compiles += 1
+        if is_trace:
+            self.metrics.inc("pipeline.jit.compiles")
+        self.metrics.hist("pipeline.jit.compile.seconds",
+                          lo=_STAGE_LO,
+                          n_buckets=_STAGE_BUCKETS).observe(dur)
+
+    # ---- snapshot (the shared schema) -----------------------------------
+    def snapshot(self) -> dict:
+        """The one pipeline-telemetry JSON schema: served by
+        GET /api/v5/pipeline/stats, embedded in bench.py's success and
+        error JSON, dumped by tools/profile_step.py --telemetry-out and
+        published (piecewise) on $SYS/brokers/<node>/pipeline/#."""
+        stages = {}
+        occupancy = {}
+        prefix_s, prefix_o = "pipeline.stage.", "pipeline.occupancy."
+        for name, h in self.metrics.histograms().items():
+            if name.startswith(prefix_s):
+                if not h.count:
+                    continue
+                snap = h.snapshot()
+                stages[name[len(prefix_s):].removesuffix(".seconds")] = {
+                    "count": snap["count"],
+                    "sum_ms": round(snap["sum"] * 1000, 3),
+                    "mean_ms": round(snap["mean"] * 1000, 4),
+                    "p50_ms": round(snap["p50"] * 1000, 4),
+                    "p95_ms": round(snap["p95"] * 1000, 4),
+                    "p99_ms": round(snap["p99"] * 1000, 4),
+                }
+            elif name.startswith(prefix_o) and h.count:
+                snap = h.snapshot()
+                occupancy[name[len(prefix_o):]] = {
+                    "count": snap["count"],
+                    "mean_fill": round(snap["mean"], 4),
+                    "p50_fill": round(min(1.0, snap["p50"]), 4),
+                }
+        with self._compiles_lock:
+            by_shape = {k: {"count": v["count"],
+                            "total_s": round(v["total_s"], 4)}
+                        for k, v in self.compiles_by_shape.items()}
+            compiles = {"count": self.compiles,
+                        "total_s": round(self.compile_s, 4),
+                        "by_shape": by_shape}
+        decisions = {
+            k.rsplit(".", 1)[1]: v
+            for k, v in self.metrics.all().items()
+            if k.startswith("pipeline.batches.")}
+        for extra in ("routing.device.bypassed", "routing.device.cold_class",
+                      "routing.device.host_fallback",
+                      "routing.device.dispatch_failed",
+                      "pipeline.slow_batches"):
+            v = self.metrics.val(extra)
+            if v:
+                decisions[extra] = v
+        out = {
+            "schema": SCHEMA,
+            "stages": stages,
+            "occupancy": occupancy,
+            "compiles": compiles,
+            "decisions": decisions,
+        }
+        jc = _jit_cache_sizes()
+        if jc:
+            out["jit_cache"] = jc
+        return out
+
+
+def _jit_cache_sizes() -> dict:
+    """Jit-cache entry counts of the route-step programs — the recompile
+    accounting's ground truth (each entry is one compiled (shape,
+    static-args) variant). Empty when jax / the models module isn't
+    loaded yet, so snapshot() never forces a jax import."""
+    import sys
+    mod = sys.modules.get("emqx_tpu.models.router_engine")
+    if mod is None:
+        return {}
+    try:
+        return mod.compile_stats()
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return {}

@@ -61,6 +61,16 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         return await mgmt.metrics()
     route("GET", "/metrics", metrics)
 
+    # ---- pipeline telemetry (device-path stage spans / occupancy /
+    #      compile accounting — broker.telemetry snapshot schema) ----
+    async def pipeline_stats(_req):
+        tele = getattr(node, "pipeline_telemetry", None)
+        if tele is None:
+            raise ApiError(404, "SERVICE_UNAVAILABLE",
+                           "pipeline telemetry not enabled")
+        return tele.snapshot()
+    route("GET", "/pipeline/stats", pipeline_stats)
+
     # ---- clients ----
     async def clients(req):
         items = await mgmt.list_clients()

@@ -172,6 +172,22 @@ def route_window_full(tables: ShapeRouterTables, cursors: jax.Array,
     return stacked
 
 
+def compile_stats() -> dict[str, int]:
+    """Jit-cache entry counts per route-step program. Each entry is one
+    compiled (shape, dtype, static-args) variant, so a growing number
+    under steady traffic means the serving path is re-tracing — the
+    recompile signal pipeline telemetry surfaces via
+    `GET /api/v5/pipeline/stats` and the bench telemetry snapshot."""
+    out = {}
+    for fn in (route_step, route_step_shapes, route_window_shapes,
+               route_window_full):
+        try:
+            out[fn.__name__] = fn._cache_size()
+        except Exception:  # noqa: BLE001 — cache introspection is best-effort
+            pass
+    return out
+
+
 def empty_router_tables(filter_cap: int = 16) -> RouterTables:
     """A valid all-empty RouterTables (useful before first build)."""
     from emqx_tpu.ops.fanout import build_subtable

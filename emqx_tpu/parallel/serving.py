@@ -530,16 +530,22 @@ class ShardedRouteServer:
         self._warm_thread.start()
 
     def _warm_one(self, Bp: int) -> None:
+        import contextlib
+
         import jax
         from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+        tele = getattr(self.node, "pipeline_telemetry", None)
         enc = (np.full((Bp, self.level_cap), I.PAD, np.int32),
                np.zeros(Bp, np.int32), np.zeros(Bp, bool),
                np.zeros(Bp, np.int32))
         with self._lock:
             tables, cursors, caps = self.tables, self.cursors, self._caps
-        res = self.step(tables, cursors, *enc,
-                        np.int32(STRATEGY_ROUND_ROBIN))
-        jax.block_until_ready(res)
+        ctx = tele.compile_context(f"warm mesh B{Bp}") \
+            if tele is not None else contextlib.nullcontext()
+        with ctx:
+            res = self.step(tables, cursors, *enc,
+                            np.int32(STRATEGY_ROUND_ROBIN))
+            jax.block_until_ready(res)
         with self._lock:
             if self._caps == caps:      # signature still current
                 self._warm_classes.add(Bp)
@@ -575,6 +581,9 @@ class ShardedRouteServer:
         msg_hash = np.array(
             [zlib.crc32(m.topic.encode()) & 0x7FFFFFFF for m in msgs]
             + [0] * pad, np.int32)
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        if tele is not None:
+            tele.record_occupancy(f"b{Bp}", len(msgs) / Bp)
         with self._lock:
             return _Handle(subs=[msgs], built=self._builts,
                            tables=self.tables, cursors=self.cursors,
@@ -587,21 +596,33 @@ class ShardedRouteServer:
         freshly written cursor row wins — a one-batch fairness blip, not
         a correctness input). The batcher serializes dispatches on one
         thread, so cursor threading across batches is ordered."""
+        import contextlib
+
         from emqx_tpu.ops.shared import STRATEGIES
         strategy = STRATEGIES.get(self.broker.shared_strategy, 0)
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
         with self._lock:
             # live cursors when no update raced (pipelined batches chain
             # round-robin state); the pinned ones otherwise — they are
             # the only set consistent with h.tables' slot layout
             cursors = self.cursors if self._builts is h.built \
                 else h.cursors
-        h.res = self.step(h.tables, cursors, *h.enc, np.int32(strategy))
+        ctx = tele.compile_context(f"mesh B{h.enc[0].shape[0]}") \
+            if tele is not None else contextlib.nullcontext()
+        with ctx:
+            h.res = self.step(h.tables, cursors, *h.enc,
+                              np.int32(strategy))
         with self._lock:
             if self._builts is h.built:    # no rebuild raced us
                 self.cursors = h.res.new_cursors
+        if tele is not None:
+            tele.observe_stage("dispatch", time.perf_counter() - t0)
 
     def materialize(self, h: _Handle) -> None:
         """Stage 3 (executor thread): device → host readbacks."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
         r = h.res
         h.np_res = {
             "matches": np.asarray(r.matches),
@@ -612,9 +633,13 @@ class ShardedRouteServer:
             "overflow": np.asarray(r.overflow),
             "occur": np.asarray(r.occur),      # [R, G]
         }
+        if tele is not None:
+            tele.observe_stage("materialize", time.perf_counter() - t0)
 
     def finish_sub(self, h: _Handle, k: int) -> list[int]:
         """Stage 4 (event loop): consume into deliveries (W=1: k==0)."""
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        t0 = time.perf_counter()
         msgs = h.subs[k]
         np_res = h.np_res
         counts = []
@@ -624,6 +649,8 @@ class ShardedRouteServer:
                 continue
             counts.append(self._consume_one(msg, i, np_res, h.built))
         self._writeback_cursors(np_res["occur"], h.built)
+        if tele is not None:
+            tele.observe_stage("deliver", time.perf_counter() - t0)
         return counts
 
     def _writeback_cursors(self, occur, builts) -> None:

@@ -8,9 +8,18 @@ same pipelined-window + digest-readback methodology as bench.py, so the
 numbers decompose the real batch cost instead of guessing.
 
 Usage: python tools/profile_step.py [subs] [batch] [window]
+                                    [--telemetry-out FILE]
+
+--telemetry-out dumps the run as a pipeline-telemetry snapshot
+(broker.telemetry SCHEMA — the same JSON shape bench.py embeds and
+GET /api/v5/pipeline/stats serves): each profiled kernel becomes a stage
+row (per-batch ms) and its warm/compile cost lands in the compile
+accounting, so profiling rounds and bench rounds share one schema.
 """
 
+import json
 import os
+import re
 import sys
 import time
 
@@ -23,10 +32,33 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _parse_args(argv):
+    """Positional [subs] [batch] [window] + --telemetry-out FILE."""
+    out = None
+    pos = []
+    it = iter(argv)
+    for a in it:
+        if a == "--telemetry-out":
+            out = next(it, None)
+        elif a.startswith("--telemetry-out="):
+            out = a.split("=", 1)[1]
+        else:
+            pos.append(a)
+    return pos, out
+
+
+def _slug(name: str) -> str:
+    return re.sub(r"[^a-z0-9]+", "_", name.lower()).strip("_")
+
+
 def main():
-    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    B = int(sys.argv[2]) if len(sys.argv) > 2 else 131072
-    window = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    pos, telemetry_out = _parse_args(sys.argv[1:])
+    subs = int(pos[0]) if len(pos) > 0 else 1_000_000
+    B = int(pos[1]) if len(pos) > 1 else 131072
+    window = int(pos[2]) if len(pos) > 2 else 16
+
+    from emqx_tpu.broker.telemetry import PipelineTelemetry
+    tele = PipelineTelemetry()
 
     import jax
     import jax.numpy as jnp
@@ -112,6 +144,7 @@ def main():
         topics_per_call: how many topics one call routes (a fused-window
         call routes FUSE*B — the table stays per-batch honest)."""
         batches_per_call = topics_per_call // B
+        stage = _slug(name)
 
         def run(n):
             acc = _put_retry(np.int32(0))
@@ -120,9 +153,11 @@ def main():
                 acc = fn(acc, tables, staged[i % 8])
             _ = int(np.asarray(acc))
             return time.time() - t0
-        run(2)  # warm/compile
+        with tele.compile_context(f"profile {stage}"):
+            run(2)  # warm/compile (attributed to this kernel's shape)
         dt = run(window)
         per_ms = dt / (window * batches_per_call) * 1000
+        tele.observe_stage(stage, per_ms / 1000.0)
         log(f"{name:34s} {per_ms:8.2f} ms/batch   "
             f"{topics_per_call*window/dt/1e6:6.1f}M/s")
         return per_ms
@@ -227,6 +262,14 @@ def main():
     timed("FULL route_step + digest", f_full)
     timed(f"FUSED window x{FUSE} (per batch)", f_window,
           topics_per_call=B * FUSE)
+
+    if telemetry_out:
+        snap = tele.snapshot()
+        snap["profile"] = {"subs": subs, "batch": B, "window": window,
+                           "fuse": FUSE}
+        with open(telemetry_out, "w") as f:
+            json.dump(snap, f, indent=1)
+        log(f"telemetry snapshot -> {telemetry_out}")
 
 
 if __name__ == "__main__":
