@@ -456,12 +456,15 @@ def run_bench(subs: int, B: int, window: int, shared_pct: int) -> dict:
             pallas_ps = _match_window(shape_match_pallas)
             winner = "pallas" if (same and pallas_ps > xla_ps) else "xla"
             # clears shape_match's jit cache, so the serving step's
-            # trace below really picks the winner up
+            # trace below really picks the winner up; effective=False
+            # means the clear failed and already-traced shapes may still
+            # run the loser (ISSUE 2 satellite: record it, don't guess)
             SHP.set_fold_backend(winner)
             pallas_fields.update({
                 "match_xla_per_s": round(xla_ps),
                 "match_pallas_per_s": round(pallas_ps),
                 "fold_backend": winner,
+                "fold_backend_effective": SHP.fold_backend_effective(),
             })
             log(f"fold backends: xla {xla_ps / 1e6:.1f}M/s, "
                 f"pallas {pallas_ps / 1e6:.1f}M/s, bit-identical={same} "
@@ -1044,8 +1047,17 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
                     acks.append(fut)
                 if len(acks) >= 256:
                     await _await_acks(acks)
+                if cl.needs_drain:
+                    # qos-0 pipeline contract (client.publish_start):
+                    # drain every N messages so the transport buffer
+                    # stays bounded — the flood's backpressure point
+                    await cl.drain()
                 if k % 64 == 63:
-                    await asyncio.sleep(0)   # let the batcher drain
+                    # independent of drain(): below the transport
+                    # high-water mark drain() returns without
+                    # suspending, so this is the loop's guaranteed
+                    # yield (let the batcher drain)
+                    await asyncio.sleep(0)
             await _await_acks(acks)
 
         async def _await_acks(acks):
@@ -1184,6 +1196,16 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
 
 
 def main():
+    if "--skew" in sys.argv:
+        # skewed-topic microbenchmark for the device-match reuse layers
+        # (ISSUE 2 acceptance: cached >= 2x the cache-disabled path);
+        # full harness lives in tools/skew_bench.py
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import skew_bench
+        skew_bench.main()
+        return
+
     # watchdog: if anything hangs (axon backend init / a stuck transfer),
     # still emit the JSON line before the driver's kill timeout hits
     import signal
@@ -1382,6 +1404,42 @@ def main():
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log(f"sharded bench failed: {type(e).__name__}: {e}")
                     result["sharded_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if os.environ.get("BENCH_SKEW", "1") != "0":
+                # hot-topic reuse microbench (ISSUE 2): cached vs
+                # cache-disabled matches/sec + hit-rate/dedup counters,
+                # CPU subprocess so it can never claim (or hang on) the
+                # relay; rides next to the telemetry the e2e rows embed
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    sp = subprocess.run(
+                        [sys.executable,
+                         os.path.join(os.path.dirname(
+                             os.path.abspath(__file__)),
+                             "tools", "skew_bench.py")],
+                        capture_output=True, text=True, env=senv,
+                        timeout=int(os.environ.get(
+                            "BENCH_SKEW_TIMEOUT_S", 600)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        # the full telemetry snapshot already rides the
+                        # e2e rows; keep the skew row compact
+                        tele = row.pop("telemetry", {})
+                        row["match_cache"] = tele.get("match_cache")
+                        row["dedup"] = tele.get("dedup")
+                        result["skew"] = row
+                    else:
+                        result["skew_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"skew bench failed: {type(e).__name__}: {e}")
+                    result["skew_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             print(json.dumps(result), flush=True)
             return

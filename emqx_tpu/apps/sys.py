@@ -69,8 +69,9 @@ class SysBroker:
         """$SYS/brokers/<node>/pipeline/# — the device-path telemetry
         snapshot, piecewise: one JSON payload per stage
         (`pipeline/stages/<stage>`), per occupancy class
-        (`pipeline/occupancy/<class>`), plus `pipeline/compiles` and
-        `pipeline/decisions`."""
+        (`pipeline/occupancy/<class>`), plus `pipeline/compiles`,
+        `pipeline/decisions` and — when the device-match reuse layers
+        have traffic — `pipeline/match_cache` / `pipeline/dedup`."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -85,6 +86,10 @@ class SysBroker:
                   json.dumps(snap["compiles"]).encode())
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
+        for section in ("match_cache", "dedup"):
+            if section in snap:
+                self._pub(f"pipeline/{section}",
+                          json.dumps(snap[section]).encode())
 
     # ---- alarms → $SYS ----
     def on_alarm_activated(self, alarm: dict) -> None:

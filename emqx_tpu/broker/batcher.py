@@ -283,7 +283,17 @@ class PublishBatcher:
                         self._since_probe += 1
                     if self.tele is not None:
                         if dispatched:
-                            self.tele.record_decision("device", len(lives))
+                            # cached = the dedup/match-cache program took
+                            # this window (engine attached a plan): the
+                            # device/device_cached decision split lets
+                            # BENCH rounds attribute throughput moves to
+                            # the reuse rate (mesh handles carry no plan
+                            # — the mesh bypasses the cache)
+                            self.tele.record_decision(
+                                "device_cached"
+                                if getattr(handle, "plan", None)
+                                is not None else "device",
+                                len(lives))
                         else:
                             # a fused group can fall back whole (e.g.
                             # prepare_window returned None mid-rebuild):

@@ -1,0 +1,149 @@
+"""Snapshot-keyed host-side LRU of device match rows.
+
+The wildcard match stage (ops.match / ops.shapes) is a pure function of
+an immutable table snapshot and the encoded topic: for one `_Built`
+snapshot, the same topic always produces the same (matches row, count,
+overflow) triple. Real MQTT publish traffic is heavily skewed to a small
+hot topic set (arXiv:1811.07088 §5, arXiv:2603.21600), so paying the
+NFA/shape-hash cost once per (snapshot, topic) instead of once per
+message removes most of the match work from the device route path.
+
+This cache holds those triples host-side, keyed by a 128-bit hash of the
+encoded level words + `is_dollar` (two independent 64-bit folds over the
+interned ids — same collision posture as ops/shapes.py's 2x32-bit path
+hashes: a wrong row needs a 128-bit collision inside one snapshot's live
+key set, ~2^-128 per pair). Rows are numpy: matches [Mw] int32, count
+int32, overflow bool, where Mw is the snapshot's match width (shape
+capacity for the shapes backend, match_cap for the trie NFA).
+
+Consistency invariant (why per-snapshot keying suffices): mutations
+never edit the device tables in place — subscription churn marks
+filters/slots dirty and those serve host-side against the PINNED
+snapshot until the next rebuild (broker/device_engine.py's
+dirty/delta scheme), so the match output for a given snapshot id never
+changes during that snapshot's lifetime. `attach()` at snapshot swap
+(DeviceRouteEngine._apply_build) is therefore the ONLY invalidation
+point needed: rows can never be stale within a snapshot, and the id
+check on every get/put batch makes cross-snapshot serving structurally
+impossible (a reader thread racing a swap inserts into /reads from
+nothing).
+
+Thread model: looked up on the event loop (prepare), populated from the
+materialize/read executor threads, invalidated on the loop at swap — one
+plain lock around the OrderedDict; every operation is a small dict walk,
+orders of magnitude below the batch work it fronts.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+DEFAULT_CAPACITY = 8192
+
+
+class MatchCache:
+    """LRU of per-(snapshot, topic) match rows with hit/miss accounting.
+
+    `metrics` is a broker.metrics.Metrics (or None): hit/miss/evict/
+    invalidation counters land there as `match_cache.*`, which is how the
+    Prometheus/StatsD/$SYS/mgmt exporters and the telemetry snapshot see
+    the cache with zero coupling to this module.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, metrics=None):
+        self.capacity = max(1, int(capacity))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._rows: OrderedDict = OrderedDict()   # key -> (m, c, o)
+        self.snapshot_id: Optional[int] = None
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def _inc(self, name: str, n: int) -> None:
+        if self.metrics is not None and n:
+            self.metrics.inc(f"match_cache.{name}", n)
+
+    def attach(self, snapshot_id: Optional[int]) -> None:
+        """Bind the cache to a new snapshot, dropping every row of the
+        previous one (wholesale invalidation at swap — see the module
+        docstring for why this is the only invalidation point)."""
+        with self._lock:
+            if self._rows:
+                self.invalidations += 1
+                self._inc("invalidations", 1)
+                self._inc("invalidated_rows", len(self._rows))
+                self._rows.clear()
+            self.snapshot_id = snapshot_id
+
+    def get_many(self, snapshot_id, keys: list) -> list:
+        """Row per key (None = miss), LRU-touching hits. A snapshot-id
+        mismatch (reader raced a swap) misses everything. Does NOT count
+        hit/miss accounting — lookups also run for windows that end up
+        dispatching the plain program, and counting those would inflate
+        the exported hit rate with reuse that never fed a dispatch; the
+        planner calls count_lookups() only for engaged plans."""
+        with self._lock:
+            if snapshot_id != self.snapshot_id:
+                return [None] * len(keys)
+            rows = self._rows
+            out = []
+            for k in keys:
+                row = rows.get(k)
+                if row is not None:
+                    rows.move_to_end(k)
+                out.append(row)
+            return out
+
+    def count_lookups(self, hits: int, misses: int) -> None:
+        """Account one ENGAGED window's lookup outcome (see get_many)."""
+        with self._lock:
+            self.hits += hits
+            self.misses += misses
+        self._inc("hits", hits)
+        self._inc("misses", misses)
+
+    def put_many(self, snapshot_id, items: list) -> None:
+        """Insert (key, row) pairs read back from a finished dispatch.
+        Dropped whole when the snapshot moved on while the batch was in
+        flight — those rows describe tables that no longer serve."""
+        n_evict = 0
+        with self._lock:
+            if snapshot_id != self.snapshot_id:
+                return
+            rows = self._rows
+            for k, row in items:
+                rows[k] = row
+                rows.move_to_end(k)
+            while len(rows) > self.capacity:
+                rows.popitem(last=False)
+                n_evict += 1
+            # instance counters stay lock-guarded (two materialize
+            # threads may finish concurrently); the Metrics incs below
+            # follow the registry's own repo-wide threading model
+            self.evictions += n_evict
+        self._inc("inserts", len(items))
+        self._inc("evictions", n_evict)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def stats(self) -> dict:
+        with self._lock:
+            size = len(self._rows)
+            hits, misses = self.hits, self.misses
+        total = hits + misses
+        return {
+            "size": size,
+            "capacity": self.capacity,
+            "snapshot_id": self.snapshot_id,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+            "evictions": self.evictions,
+            "invalidations": self.invalidations,
+        }

@@ -82,7 +82,11 @@ class Node:
                 self,
                 rebuild_threshold=perf.get("rebuild_threshold", 256),
                 fanout_cap=perf.get("device_fanout_cap", 128),
-                slot_cap=perf.get("device_slot_cap", 16))
+                slot_cap=perf.get("device_slot_cap", 16),
+                # device-match reuse layers (None = env / built-in
+                # default; see EMQX_TPU_MATCH_CACHE / EMQX_TPU_DEDUP)
+                match_cache_size=perf.get("match_cache_size"),
+                dedup=perf.get("topic_dedup"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),

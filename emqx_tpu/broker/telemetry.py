@@ -12,6 +12,10 @@ for every pipeline stage —
     dispatch     the jitted route step, executor-thread wall time (on a
                  dispatch relay this is the HTTP round trip; match +
                  fan-out + shared picks all run inside it on device)
+    dispatch_cached  same span for deduplicated / match-cache-backed
+                 dispatches (route_*_cached) — the cached-vs-uncached
+                 match latency split falls straight out of comparing the
+                 two histograms
     materialize  device->host readbacks
     deliver      RouteResult consumption into session deliveries
     host_route   host-path match + route span for host-routed batches
@@ -45,8 +49,8 @@ from emqx_tpu.broker.metrics import Metrics
 
 SCHEMA = "emqx_tpu.pipeline/v1"
 
-STAGES = ("enqueue", "batch_form", "dispatch", "materialize", "deliver",
-          "host_route", "host_match", "total")
+STAGES = ("enqueue", "batch_form", "dispatch", "dispatch_cached",
+          "materialize", "deliver", "host_route", "host_match", "total")
 
 # stage histograms: 1us .. ~134s in 28 log2 buckets
 _STAGE_LO, _STAGE_BUCKETS = 1e-6, 28
@@ -160,6 +164,20 @@ class PipelineTelemetry:
                           lo=_OCC_LO, n_buckets=_OCC_BUCKETS,
                           unit="ratio").observe(fill)
 
+    # ---- dedup / match-cache (device-path reuse layers) ------------------
+    def record_dedup(self, lanes: int, unique: int) -> None:
+        """One dispatch window's unique-topic compaction: `lanes` real
+        (non-padding) message lanes collapsed onto `unique` distinct
+        encoded topics. Feeds the dedup-ratio histogram (1 - Bu/B, the
+        fraction of match work the window skipped) plus running lane /
+        unique counters so exporters can derive the aggregate ratio."""
+        self.metrics.inc("routing.dedup.lanes", lanes)
+        self.metrics.inc("routing.dedup.unique", unique)
+        if lanes:
+            self.metrics.hist("pipeline.dedup.ratio",
+                              lo=_OCC_LO, n_buckets=_OCC_BUCKETS,
+                              unit="ratio").observe(1.0 - unique / lanes)
+
     # ---- routing decisions ----------------------------------------------
     def record_decision(self, path: str, n: int = 1) -> None:
         """Formed batches' device/host routing outcome
@@ -237,12 +255,33 @@ class PipelineTelemetry:
             for k, v in self.metrics.all().items()
             if k.startswith("pipeline.batches.")}
         for extra in ("routing.device.bypassed", "routing.device.cold_class",
+                      "routing.device.cold_cached_class",
+                      "routing.device.cached_windows",
                       "routing.device.host_fallback",
                       "routing.device.dispatch_failed",
                       "pipeline.slow_batches"):
             v = self.metrics.val(extra)
             if v:
                 decisions[extra] = v
+        # device-match reuse layers: cross-batch cache + in-window dedup
+        # (broker/device_engine.py; counters land in the shared Metrics
+        # registry, so all four exporters already carry them — this
+        # section is the derived view benches and the API embed)
+        cache = {}
+        for k in ("hits", "misses", "inserts", "evictions",
+                  "invalidations", "invalidated_rows"):
+            v = self.metrics.val(f"match_cache.{k}")
+            if v:
+                cache[k] = v
+        lookups = cache.get("hits", 0) + cache.get("misses", 0)
+        if lookups:
+            cache["hit_rate"] = round(cache.get("hits", 0) / lookups, 4)
+        dedup = {}
+        lanes = self.metrics.val("routing.dedup.lanes")
+        if lanes:
+            uniq = self.metrics.val("routing.dedup.unique")
+            dedup = {"lanes": lanes, "unique": uniq,
+                     "ratio": round(1.0 - uniq / lanes, 4)}
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -250,6 +289,10 @@ class PipelineTelemetry:
             "compiles": compiles,
             "decisions": decisions,
         }
+        if cache:
+            out["match_cache"] = cache
+        if dedup:
+            out["dedup"] = dedup
         jc = _jit_cache_sizes()
         if jc:
             out["jit_cache"] = jc

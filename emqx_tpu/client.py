@@ -58,6 +58,12 @@ class Client:
         self._suback: dict[int, asyncio.Future] = {}
         self.closed = asyncio.Event()
         self.auto_ack = True
+        # qos-0 pipelining backpressure (see publish_start): flood loops
+        # must `await drain()` at least every `qos0_drain_every`
+        # publish_start(qos=0) calls or the transport buffer grows
+        # unboundedly (asyncio never blocks a bare write())
+        self.qos0_drain_every = 64
+        self._q0_undrained = 0
         self._scram = None
         self._scram_mech = ""
         self.scram_server_ok: Optional[bool] = None
@@ -227,10 +233,25 @@ class Client:
                       properties: Optional[dict] = None):
         """Send a PUBLISH without awaiting its ack: for qos>0 returns the
         ack future (await it later — pipelined publishing keeps a flood's
-        connections full instead of stalling a round trip per message)."""
+        connections full instead of stalling a round trip per message).
+
+        PIPELINE CONTRACT (qos 0): the return is None and the bytes only
+        sit in the transport write buffer — asyncio's write() never
+        blocks, so a tight flood loop grows that buffer without bound.
+        Callers pipelining qos-0 publishes MUST apply backpressure by
+        awaiting `drain()` periodically; `needs_drain` flips True every
+        `qos0_drain_every` un-drained qos-0 publishes as the cue:
+
+            cl.publish_start(t, p)            # fire-and-forget
+            if cl.needs_drain:
+                await cl.drain()              # bounded transport buffer
+
+        qos>0 floods get the same bound for free by awaiting their ack
+        futures in windows (the broker acks only what it has read)."""
         if qos == 0:
             self._send(P.Publish(topic=topic, payload=payload, qos=0,
                                  retain=retain, properties=properties))
+            self._q0_undrained += 1
             return None
         pid = self._alloc()
         fut = asyncio.get_event_loop().create_future()
@@ -240,13 +261,27 @@ class Client:
                              properties=properties))
         return fut
 
+    @property
+    def needs_drain(self) -> bool:
+        """True once `qos0_drain_every` qos-0 publishes went un-drained —
+        the publish_start pipeline contract's backpressure cue."""
+        return self._q0_undrained >= self.qos0_drain_every
+
+    async def drain(self) -> None:
+        """Flush the transport write buffer (asyncio flow control): the
+        qos-0 pipeline contract's backpressure point. Stalls only while
+        the buffer is over the transport's high-water mark."""
+        self._q0_undrained = 0
+        if self._writer is not None:
+            await self._writer.drain()
+
     async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
                       retain: bool = False,
                       properties: Optional[dict] = None,
                       timeout: float = 5.0) -> Optional[P.Packet]:
         fut = self.publish_start(topic, payload, qos, retain, properties)
         if fut is None:
-            await self._writer.drain()
+            await self.drain()
             return None
         return await asyncio.wait_for(fut, timeout)
 

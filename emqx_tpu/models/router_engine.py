@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from emqx_tpu.ops.fanout import FanoutResult, SubTable, fanout_normal, shared_slots
-from emqx_tpu.ops.match import MatchResult, match_batch
+from emqx_tpu.ops.match import MatchResult, match_batch, merge_match_results
 from emqx_tpu.ops.shapes import ShapeTables, shape_match
 from emqx_tpu.ops.shared import SharedPickResult, pick_members
 from emqx_tpu.ops.trie import TrieTables
@@ -97,6 +97,74 @@ def route_step_shapes(tables: ShapeRouterTables, cursors: jax.Array,
     mr = shape_match(tables.shapes, topics, lens, is_dollar)
     return post_match(tables.subs, mr, cursors, msg_hash, strategy,
                       fanout_cap=fanout_cap, slot_cap=slot_cap)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap", "slot_cap"))
+def route_step_cached(tables: RouterTables, cursors: jax.Array,
+                      miss_topics: jax.Array, miss_lens: jax.Array,
+                      miss_dollar: jax.Array, base_matches: jax.Array,
+                      base_counts: jax.Array, base_overflow: jax.Array,
+                      miss_pos: jax.Array, inv: jax.Array,
+                      msg_hash: jax.Array, strategy: jax.Array, *,
+                      frontier_cap: int = 16, match_cap: int = 64,
+                      fanout_cap: int = 128,
+                      slot_cap: int = 16) -> RouteResult:
+    """Trie-NFA route step over a DEDUPLICATED batch with cached rows.
+
+    The match stage runs only on the [Bm] compacted miss lanes
+    (Bm quantized to the standard batch-class ladder); cache-hit unique
+    topics ride in as host-filled base_* rows ([U] per-unique-topic).
+    `inv` [B] scatters the merged unique MatchResult back to full batch
+    width before the cursor-dependent post stage, so fan-out, shared
+    picks and cursor threading are bit-identical to the un-deduplicated
+    `route_step` on the same batch (oracle-tested)."""
+    mr = match_batch(tables.trie, miss_topics, miss_lens, miss_dollar,
+                     frontier_cap=frontier_cap, match_cap=match_cap)
+    um = merge_match_results(base_matches, base_counts, base_overflow,
+                             mr, miss_pos)
+    full = MatchResult(matches=um.matches[inv], counts=um.counts[inv],
+                       overflow=um.overflow[inv])
+    return post_match(tables.subs, full, cursors, msg_hash, strategy,
+                      fanout_cap=fanout_cap, slot_cap=slot_cap)
+
+
+@functools.partial(jax.jit, static_argnames=("fanout_cap", "slot_cap"))
+def route_window_cached(tables: ShapeRouterTables, cursors: jax.Array,
+                        miss_topics: jax.Array, miss_lens: jax.Array,
+                        miss_dollar: jax.Array, base_matches: jax.Array,
+                        base_counts: jax.Array, base_overflow: jax.Array,
+                        miss_pos: jax.Array, inv: jax.Array,
+                        msg_hash: jax.Array, strategy: jax.Array, *,
+                        fanout_cap: int = 128,
+                        slot_cap: int = 16) -> RouteResult:
+    """Shape-hash window step over a DEDUPLICATED window with cached rows.
+
+    One dispatch routes W sub-batches while the shape-hash match runs
+    ONCE over the [Bm] compacted miss lanes (every other lane of the
+    [W, B] window is either a duplicate of a miss lane, a cache hit
+    served from base_* rows, or padding collapsed onto the shared
+    sentinel row). `inv` [W, B] gathers the merged unique rows back to
+    full window width per scan step; cursors thread through the scan
+    exactly as W sequential `route_step_shapes` calls, so the stacked
+    RouteResult is bit-identical to `route_window_full` on the same
+    window (oracle-tested)."""
+    mr = shape_match(tables.shapes, miss_topics, miss_lens, miss_dollar)
+    um = merge_match_results(base_matches, base_counts, base_overflow,
+                             mr, miss_pos)
+
+    def step(cur, xs):
+        inv_k, mh_k = xs
+        full = MatchResult(matches=um.matches[inv_k],
+                           counts=um.counts[inv_k],
+                           overflow=um.overflow[inv_k])
+        r = post_match(tables.subs, full, cur, mh_k, strategy,
+                       fanout_cap=fanout_cap, slot_cap=slot_cap)
+        return r.new_cursors, r
+
+    _, stacked = jax.lax.scan(step, cursors, (inv, msg_hash))
+    return stacked
 
 
 def route_digest(r: RouteResult) -> jax.Array:
@@ -180,7 +248,7 @@ def compile_stats() -> dict[str, int]:
     `GET /api/v5/pipeline/stats` and the bench telemetry snapshot."""
     out = {}
     for fn in (route_step, route_step_shapes, route_window_shapes,
-               route_window_full):
+               route_window_full, route_step_cached, route_window_cached):
         try:
             out[fn.__name__] = fn._cache_size()
         except Exception:  # noqa: BLE001 — cache introspection is best-effort

@@ -123,6 +123,28 @@ def match_batch(tables: TrieTables, topics: jax.Array, lens: jax.Array,
     return MatchResult(matches=out, counts=jnp.minimum(count, M), overflow=oflow)
 
 
+def merge_match_results(base_matches: jax.Array, base_counts: jax.Array,
+                        base_overflow: jax.Array, mr: MatchResult,
+                        miss_pos: jax.Array) -> MatchResult:
+    """Scatter a miss sub-batch's fresh MatchResult into cached base rows.
+
+    base_*: [U, ...] per-unique-topic rows (cache hits filled by the host,
+    everything else garbage-initialized to the empty row). mr: the match
+    output for the [Bm] compacted miss lanes. miss_pos: [Bm] destination
+    row of each miss lane in the unique array; padding lanes MUST carry
+    an out-of-range POSITIVE index (>= U) so mode="drop" discards them —
+    a -1 pad would WRAP (jax wraps negative dynamic indices before the
+    bounds check) and clobber row U-1 with the empty pad match. The
+    match stage is a pure function of the
+    immutable table snapshot, so a cached row and a fresh row for the same
+    (snapshot, topic) are bit-identical by construction — merging is a
+    plain last-writer scatter, no reconciliation needed."""
+    return MatchResult(
+        matches=base_matches.at[miss_pos].set(mr.matches, mode="drop"),
+        counts=base_counts.at[miss_pos].set(mr.counts, mode="drop"),
+        overflow=base_overflow.at[miss_pos].set(mr.overflow, mode="drop"))
+
+
 def encode_topics_str(intern, topics: list, max_levels: int):
     """Encode publish topics from their raw strings — ONE native call
     for the whole batch when the library + mirror are available (split,

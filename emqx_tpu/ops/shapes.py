@@ -444,6 +444,13 @@ if _FOLD_BACKEND not in ("xla", "pallas"):
         f"EMQX_TPU_FOLD={_FOLD_BACKEND!r}: expected 'xla' or 'pallas'")
 
 
+# False when the last backend switch could not clear shape_match's jit
+# cache: already-traced avals may silently keep serving the OLD fold —
+# bench.py records this next to the measured rates so a "winner shipped"
+# claim is falsifiable (see fold_backend_effective()).
+_FOLD_BACKEND_EFFECTIVE = True
+
+
 def set_fold_backend(name: str) -> None:
     """Select the fold backend for subsequently TRACED programs (bench.py
     measures both on the live hardware and ships the winner — VERDICT r4
@@ -452,16 +459,33 @@ def set_fold_backend(name: str) -> None:
     jaxpr (populated by the tuning calls themselves) would silently keep
     the old backend for identical avals. Outer programs already jitted
     (route_step_shapes etc.) keep the backend they traced with; call
-    before tracing the serving step."""
-    global _FOLD_BACKEND
+    before tracing the serving step.
+
+    A clear_cache failure is NOT swallowed silently: it logs a warning
+    and flips `fold_backend_effective()` False, so bench rows record
+    that the switch may not have taken effect for already-seen shapes."""
+    global _FOLD_BACKEND, _FOLD_BACKEND_EFFECTIVE
     if name not in ("xla", "pallas"):
         raise ValueError(f"fold backend {name!r}: expected xla or pallas")
     if name != _FOLD_BACKEND:
         _FOLD_BACKEND = name
         try:
             shape_match.clear_cache()
-        except Exception:   # noqa: BLE001 — cache API is best-effort
-            pass
+            _FOLD_BACKEND_EFFECTIVE = True
+        except Exception as e:   # noqa: BLE001 — switch degrades, loudly
+            _FOLD_BACKEND_EFFECTIVE = False
+            import logging
+            logging.getLogger("emqx_tpu.shapes").warning(
+                "set_fold_backend(%r): shape_match.clear_cache() failed "
+                "(%s: %s) — programs already traced keep the previous "
+                "fold backend for identical shapes; only NEW shape "
+                "classes pick up the switch", name, type(e).__name__, e)
+
+
+def fold_backend_effective() -> bool:
+    """True when the last set_fold_backend() fully took effect (the jit
+    cache cleared, so every subsequent trace uses the selected fold)."""
+    return _FOLD_BACKEND_EFFECTIVE
 
 
 def _fold_pallas(st: ShapeTables, topics, lens, is_dollar):

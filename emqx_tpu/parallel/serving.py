@@ -560,8 +560,18 @@ class ShardedRouteServer:
     def prepare(self, msgs: list[Message]) -> Optional[_Handle]:
         return self.prepare_window([msgs])
 
-    def prepare_window(self, lives) -> Optional[_Handle]:
-        """Stage 1 (event loop): encode one micro-batch (W=1)."""
+    def prepare_window(self, lives, gate_cold: bool = True) -> \
+            Optional[_Handle]:
+        """Stage 1 (event loop): encode one micro-batch (W=1).
+
+        The single-chip engine's match cache / dedup layer is explicitly
+        BYPASSED here: the mesh step matches against R per-shard table
+        stacks whose slices are updated independently (update_shard), so
+        there is no single snapshot id a cached row could be keyed to —
+        a per-shard (shard, generation) key space is the prerequisite
+        before the mesh can consult the same cache. Until then every
+        mesh batch pays the full sharded match, and stats() reports the
+        bypass so bench rows can't mistake it for a cold cache."""
         if not self.poll_rebuild() or self._builts is None or not lives:
             return None
         from emqx_tpu.ops.match import encode_topics_str
@@ -697,7 +707,7 @@ class ShardedRouteServer:
         dev_shared = self.broker.shared_strategy in self._dev_strategies()
         n = 0
         matched: list[str] = []
-        deep_matched: list[str] = []
+        handled: set[tuple] = set()   # (filter, group) the mesh served
         for r in range(self.n_route):
             b = builts[r]
             off = 0
@@ -727,7 +737,6 @@ class ShardedRouteServer:
             for f, _fws in b.host_extra:
                 if T.match(msg.topic, f):
                     matched.append(f)
-                    deep_matched.append(f)
                     n += broker.dispatch(f, msg)
             if dev_shared:
                 srow = np_res["shared_sids"][i, r]
@@ -737,6 +746,7 @@ class ShardedRouteServer:
                     if slot < 0 or slot >= len(b.slot_key):
                         continue
                     f, gname = b.slot_key[slot]
+                    handled.add((f, gname))
                     sid = int(prow[k])
                     if sid >= _REMOTE_SID_BASE:
                         # device picked a remote member: directed
@@ -775,16 +785,24 @@ class ShardedRouteServer:
                                 n += 1
         if not dev_shared:
             n += broker._dispatch_shared(msg, matched)
-        elif deep_matched:
-            # too-deep filters never get device slots (host_extra above):
-            # their groups dispatch host-side even in device-shared mode
-            # — without this a shared sub on a deep filter got ZERO
-            # deliveries (round-4 advisor finding)
-            for f in deep_matched:
+        else:
+            # handled-set sweep (single-chip engine parity, round-5
+            # advisor finding): any (filter, group) LIVE on a matched
+            # filter but absent from this handle's pinned shard snapshot
+            # dispatches host-side. That covers groups subscribed
+            # between prepare and finish (the per-shard update landed
+            # AFTER this batch's snapshot was pinned — they previously
+            # got ZERO deliveries), and too-deep filters' groups, which
+            # never get device slots (host_extra above, round-4 advisor
+            # finding).
+            for f in matched:
                 names = set(broker.shared.get(f, ()))
                 if cluster is not None:
                     names |= cluster._groups_by_real.get(f, set())
                 for gname in names:
+                    if (f, gname) in handled:
+                        continue
+                    handled.add((f, gname))
                     if self._host_shared_dispatch(f, gname, msg):
                         n += 1
         if cluster:
@@ -845,4 +863,8 @@ class ShardedRouteServer:
             "dirty_shards": sorted(self.dirty_shards),
             "caps": dict(self._caps or {}),
             "warm_classes": sorted(self._warm_classes),
+            # the single-chip engine's snapshot-keyed match cache needs a
+            # per-shard key space on the mesh — explicitly bypassed here
+            # (see prepare_window), not merely cold
+            "match_cache": "bypassed",
         }

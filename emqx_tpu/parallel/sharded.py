@@ -174,11 +174,15 @@ def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
         shared_opts=per_topic_spec,
         overflow=per_topic_spec, new_cursors=table_spec, occur=table_spec)
 
-    mapped = jax.shard_map(
-        local_step, mesh=mesh,
-        in_specs=(table_spec, table_spec, P("dp"), P("dp"), P("dp"), P("dp"),
-                  P()),
-        out_specs=out_specs,
-        check_vma=False,
-    )
+    in_specs = (table_spec, table_spec, P("dp"), P("dp"), P("dp"), P("dp"),
+                P())
+    if hasattr(jax, "shard_map"):
+        mapped = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+    else:
+        # jax < 0.6: the API lives in jax.experimental and the
+        # replication-check kwarg is check_rep (same semantics)
+        from jax.experimental.shard_map import shard_map
+        mapped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
     return jax.jit(mapped)

@@ -239,6 +239,35 @@ def test_deep_filter_shared_group_delivers(mc_node):
     assert len(a.msgs) + len(b.msgs) == 6    # exactly-once per message
 
 
+def test_group_subscribed_mid_flight_gets_delivery(mc_node):
+    """A $share group subscribed BETWEEN prepare and finish lives only
+    in the host dicts — the in-flight handle's pinned shard snapshot has
+    no slot for it. The handled-set sweep (round-5 advisor finding) must
+    dispatch it host-side; previously it got ZERO deliveries."""
+    node = mc_node
+    broker = node.broker
+    eng = node.device_engine
+    cap = Capture()
+    broker.subscribe(broker.register(cap, "mf-a"), "mid/flight/t")
+    assert eng.route_batch(wait=True,
+                           msgs=[make("p", 0, "mid/flight/t", b"0")]) == [1]
+    h = eng.prepare([make("p", 0, "mid/flight/t", b"1")])
+    assert h is not None                    # snapshot pinned pre-churn
+    late = Capture()
+    broker.subscribe(broker.register(late, "mf-late"),
+                     "$share/lg/mid/flight/t")
+    eng.dispatch(h)
+    eng.materialize(h)
+    counts = eng.finish(h)
+    assert counts == [2], counts            # normal sub + late group
+    assert len(late.msgs) == 1 and late.msgs[0].payload == b"1"
+    # the NEXT batch serves the group from its (updated) device slot and
+    # the sweep must not double-deliver it
+    assert eng.route_batch(wait=True,
+                           msgs=[make("p", 0, "mid/flight/t", b"2")]) == [2]
+    assert len(late.msgs) == 2
+
+
 def test_cluster_shared_dispatch_on_mesh(loop):
     """VERDICT r4 missing #4: a clustered multichip node keeps shared
     picks ON-DEVICE — the shard snapshot holds the cluster-wide

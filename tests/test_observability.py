@@ -480,7 +480,8 @@ class TestCompileAccounting:
         from emqx_tpu.models.router_engine import compile_stats
         st = compile_stats()
         assert set(st) <= {"route_step", "route_step_shapes",
-                           "route_window_shapes", "route_window_full"}
+                           "route_window_shapes", "route_window_full",
+                           "route_step_cached", "route_window_cached"}
         assert all(isinstance(v, int) for v in st.values())
 
 
