@@ -70,8 +70,9 @@ class SysBroker:
         snapshot, piecewise: one JSON payload per stage
         (`pipeline/stages/<stage>`), per occupancy class
         (`pipeline/occupancy/<class>`), plus `pipeline/compiles`,
-        `pipeline/decisions` and — when the device-match reuse layers
-        have traffic — `pipeline/match_cache` / `pipeline/dedup`."""
+        `pipeline/decisions` and — when the relevant layer has traffic —
+        `pipeline/match_cache` / `pipeline/dedup` / `pipeline/readback`
+        (dense-vs-compact device→host transfer bytes, ISSUE 3)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -86,7 +87,7 @@ class SysBroker:
                   json.dumps(snap["compiles"]).encode())
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
-        for section in ("match_cache", "dedup"):
+        for section in ("match_cache", "dedup", "readback"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

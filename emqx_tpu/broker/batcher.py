@@ -288,12 +288,21 @@ class PublishBatcher:
                             # device/device_cached decision split lets
                             # BENCH rounds attribute throughput moves to
                             # the reuse rate (mesh handles carry no plan
-                            # — the mesh bypasses the cache)
-                            self.tele.record_decision(
-                                "device_cached"
-                                if getattr(handle, "plan", None)
-                                is not None else "device",
-                                len(lives))
+                            # — the mesh bypasses the cache).
+                            # device_compact = plain program with the CSR
+                            # readback attached; a cached window may ALSO
+                            # be compact — routing.device.compact_windows
+                            # (incremented at materialize) is the
+                            # authoritative compact count, this split
+                            # stays the routing-decision view
+                            if getattr(handle, "plan", None) is not None:
+                                path = "device_cached"
+                            elif getattr(handle, "pcap", None) \
+                                    is not None:
+                                path = "device_compact"
+                            else:
+                                path = "device"
+                            self.tele.record_decision(path, len(lives))
                         else:
                             # a fused group can fall back whole (e.g.
                             # prepare_window returned None mid-rebuild):

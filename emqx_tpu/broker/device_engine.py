@@ -72,6 +72,7 @@ import numpy as np
 
 from emqx_tpu.broker.match_cache import DEFAULT_CAPACITY, MatchCache
 from emqx_tpu.broker.message import Message
+from emqx_tpu.ops.compact import csr_slices
 from emqx_tpu.ops import intern as I
 from emqx_tpu.utils import topic as T
 
@@ -88,6 +89,12 @@ _PACKED_KEYS = {"qos", "nl", "rap", "rh"}
 _ENV_DEDUP = os.environ.get("EMQX_TPU_DEDUP", "1") \
     not in ("0", "false", "off")
 _ENV_CACHE = os.environ.get("EMQX_TPU_MATCH_CACHE")
+#   EMQX_TPU_COMPACT_READBACK=0 disables the CSR readback compaction
+#   (ISSUE 3): materialize transfers the full padded result planes
+#   instead of offsets + actual entries (the A/B knob the acceptance
+#   criteria compare; config key broker.compact_readback beats the env)
+_ENV_COMPACT = os.environ.get("EMQX_TPU_COMPACT_READBACK", "1") \
+    not in ("0", "false", "off")
 
 _snapshot_ids = itertools.count(1)
 
@@ -157,6 +164,22 @@ class _CacheInfo:
         self.inserts = inserts
 
 
+class _CsrRes:
+    """Host side of one compacted readback (ISSUE 3): the CSR planes
+    materialize transferred instead of the dense result planes, plus the
+    always-small dense overflow/occur planes consume needs anyway.
+    finish_sub dispatches on this type vs the dense 8-tuple."""
+
+    __slots__ = ("off", "c3", "pay", "overflow", "occur")
+
+    def __init__(self, off, c3, pay, overflow, occur):
+        self.off = off            # [W, B+1] combined payload offsets
+        self.c3 = c3              # [W, B, 3] (match, fanout, shared)
+        self.pay = pay            # [W, P] flat payload
+        self.overflow = overflow  # [W, B] host-fallback lanes
+        self.occur = occur        # [W, G] cursor writeback input
+
+
 def _pack_opts(opts: dict) -> int:
     return ((int(opts.get("qos", 0)) & 0x3)
             | ((1 if opts.get("nl") else 0) << 2)
@@ -204,7 +227,22 @@ def capture_shared(broker, f: str) -> dict:
     For the `sticky` strategy the returned cursor is the sticky member's
     INDEX in the members list (establishing affinity on the first
     capture if none exists) — the device kernel reinterprets the cursor
-    as the affinity pointer and never advances it (ops.shared)."""
+    as the affinity pointer and never advances it (ops.shared).
+
+    Sticky-seeding invariant (ADVICE r5): establishing affinity is the
+    ONE write this otherwise read-only capture performs (grp.sticky /
+    cluster._shared_sticky), and it is IDEMPOTENT by construction —
+    it only runs when no live member holds affinity, and every writer
+    derives the same deterministic value from the same source
+    (members[0] of the insertion-ordered members dict standalone;
+    refs[0] of cluster._members' SORTED (origin, sid) view clustered).
+    Two captures racing on different threads (a sync rebuild on a
+    route_batch(wait=True) thread vs a loop-side chunked capture)
+    therefore converge on the same member: the race is benign, the
+    seeded snapshots agree, and re-running capture never moves an
+    established affinity (the `not in` guards below). Do not replace
+    the guarded writes with unconditional ones — that is what keeps
+    concurrent captures convergent."""
     cluster = broker.cluster
     sticky_mode = broker.shared_strategy == "sticky"
     local = broker.shared.get(f) or {}
@@ -292,20 +330,23 @@ class _Handle:
     sub has been finished or abandoned."""
 
     __slots__ = ("subs", "built", "dev_shared", "enc", "res", "np_res",
-                 "np_counts", "error", "refs", "t0", "plan", "cache_info")
+                 "np_counts", "error", "refs", "t0", "plan", "cache_info",
+                 "pcap", "cres")
 
     def __init__(self, subs, built, dev_shared):
         self.subs = subs          # list of (msgs, words_list, too_long)
         self.built = built
         self.dev_shared = dev_shared
         self.res = None       # device RouteResult, fields [W, ...]
-        self.np_res = None    # host numpy views (set by materialize)
+        self.np_res = None    # host views: dense tuple OR _CsrRes
         self.np_counts = None  # match_counts [W, B] (cache population)
         self.error = None
         self.refs = len(subs)
         self.t0 = None        # consumer-side window processing start
         self.plan = None      # _CachePlan: dedup/cached dispatch inputs
         self.cache_info = None  # _CacheInfo: rows to insert post-readback
+        self.pcap = None      # payload class: CSR-compact this dispatch
+        self.cres = None      # device CompactPlanes (set by dispatch)
 
 
 class DeviceRouteEngine:
@@ -314,7 +355,8 @@ class DeviceRouteEngine:
                  match_cap: int = 64, fanout_cap: int = 128,
                  slot_cap: int = 16, shape_cap: int = 32,
                  match_cache_size: Optional[int] = None,
-                 dedup: Optional[bool] = None):
+                 dedup: Optional[bool] = None,
+                 compact_readback: Optional[bool] = None):
         self.node = node
         self.broker = node.broker
         self.router = node.broker.router
@@ -376,6 +418,20 @@ class DeviceRouteEngine:
         self._match_cache: Optional[MatchCache] = \
             MatchCache(match_cache_size, node.metrics) \
             if (self.dedup and match_cache_size > 0) else None
+
+        # CSR readback compaction (ISSUE 3 tentpole): materialize ships
+        # offsets + actual entries instead of the padded result planes.
+        # Config beats env beats default-on; payload capacity quantizes
+        # onto _PAYLOAD_MULTS * Bp classes sized by a peak-biased EWMA
+        # of recent window totals, with a dense-readback fallback when a
+        # window outgrows its class (row_overflow).
+        if compact_readback is None:
+            compact_readback = _ENV_COMPACT
+        self.compact_readback = bool(compact_readback)
+        self._pay_ewma: dict[int, float] = {}   # Bp -> peak entry total
+        # compact (W, Bp[, Bm], P) classes the serving path asked for,
+        # warmed by the same background thread as the cached ladder
+        self._wanted_compact: set = set()
 
         # wire change notifications
         self.router.on_route_change = self.note_route_change
@@ -620,6 +676,7 @@ class DeviceRouteEngine:
             # stale ones must not be background-recompiled after every
             # swap for the rest of the process lifetime
             self._wanted_cached.clear()
+            self._wanted_compact.clear()
         # match-cache invalidation: wholesale, HERE, and nowhere else.
         # Invariant: within one snapshot's lifetime the device tables are
         # immutable — subscription churn marks filters/slots dirty and
@@ -987,6 +1044,79 @@ class DeviceRouteEngine:
 
     _STD_CLASSES = ((1, 64), (1, 256), (1, 1024), (8, 1024))
 
+    # payload classes are multiples of the batch class Bp (entries per
+    # message budget): 8 covers trickle fan-out, 32 the fan-out ≤ ~10
+    # regime the motivation targets, 128 heavy fan-out. Beyond 128 the
+    # compacted payload approaches the dense planes and compaction stops
+    # paying — the chooser returns None (dense readback).
+    _PAYLOAD_MULTS = (8, 32, 128)
+
+    def _dense_msg_entries(self, b=None) -> int:
+        """Dense readback cost per message lane in int32-equivalent
+        entries: match plane + fan rows/opts + shared slot/row/opts."""
+        b = b or self._built
+        return b.match_width + 2 * self.fanout_cap + 3 * self.slot_cap
+
+    def _choose_payload_cap(self, Bp: int) -> Optional[int]:
+        """Payload class for a (·, Bp) dispatch, or None for dense.
+
+        Sized by a peak-biased EWMA of recent per-window-row entry
+        totals (adopts an upward sample outright, decays slowly — see
+        _note_payload) with 2x headroom, quantized onto the
+        _PAYLOAD_MULTS * Bp ladder so the compile-class count stays
+        bounded. A window that still outgrows its class falls back to
+        the dense readback of the SAME dispatch (row_overflow), so an
+        undershoot costs bytes, never correctness."""
+        if not self.compact_readback or self._built is None:
+            return None
+        dense = self._dense_msg_entries()
+        mults = [m for m in self._PAYLOAD_MULTS if m < dense]
+        if not mults:
+            return None         # tiny caps: nothing to compact away
+        ew = self._pay_ewma.get(Bp)
+        if ew is None:
+            # no traffic measured at this class yet: start mid-ladder
+            # (the first window's offsets seed the EWMA either way)
+            return mults[min(1, len(mults) - 1)] * Bp
+        for m in mults:
+            if m * Bp >= 2.0 * ew:
+                return m * Bp
+        return None             # sustained heavy fan-out: dense wins
+
+    def _note_payload(self, Bp: int, totals: np.ndarray) -> None:
+        """Feed the EWMA from one window's actual per-row entry totals
+        (read from the offsets plane — available on the overflow
+        fallback too, which is exactly when learning matters most)."""
+        s = float(totals.max()) if totals.size else 0.0
+        ew = self._pay_ewma.get(Bp)
+        # peak-biased: adopt growth immediately (the next window must
+        # not overflow again), decay shrinkage slowly (a lull must not
+        # trigger a class downshift and an overflow on the next burst)
+        self._pay_ewma[Bp] = s if (ew is None or s > ew) \
+            else 0.8 * ew + 0.2 * s
+
+    def _gate_compact(self, Wp: int, Bp: int, plan,
+                      gate_cold: bool) -> Optional[int]:
+        """Choose + warm-gate the payload class for one dispatch.
+        Returns the class, or None to read back dense (compaction off,
+        unprofitable, or the class is cold on the serving path)."""
+        pcap = self._choose_payload_cap(Bp)
+        if pcap is None:
+            return None
+        key = (self._cur_sig, Wp, Bp) \
+            + ((plan.Bm,) if plan is not None else ()) + (f"c{pcap}",)
+        if gate_cold and key not in self._warm_classes:
+            # same policy as the cached ladder: a cold compact class
+            # would stall serving on an in-path XLA compile — dispatch
+            # with the dense readback and let the background warm bring
+            # the class online
+            self._wanted_compact.add(
+                (Wp, Bp, plan.Bm if plan is not None else None, pcap))
+            self._kick_class_warm()
+            self.node.metrics.inc("routing.device.cold_compact_class")
+            return None
+        return pcap
+
     def _kick_class_warm(self) -> None:
         """Warm every standard (W, Bp) class AND every demand-registered
         cached-dispatch (W, Bp, Bm) class the CURRENT snapshot is
@@ -1008,7 +1138,13 @@ class DeviceRouteEngine:
         cached_missing = [
             (W, Bp, Bm) for W, Bp, Bm in sorted(self._wanted_cached)
             if (self._cur_sig, W, Bp, Bm) not in self._warm_classes]
-        if not missing and not cached_missing:
+        compact_missing = [
+            e for e in sorted(self._wanted_compact,
+                              key=lambda e: (e[0], e[1], e[2] or 0, e[3]))
+            if (self._cur_sig, e[0], e[1])
+            + ((e[2],) if e[2] is not None else ())
+            + (f"c{e[3]}",) not in self._warm_classes]
+        if not missing and not cached_missing and not compact_missing:
             return
         try:
             loop = asyncio.get_running_loop()
@@ -1073,6 +1209,65 @@ class DeviceRouteEngine:
                             slot_cap=self.slot_cap)
                     jax.block_until_ready(r.match_counts)
                 self._warm_classes.add((sig, Wp, Bp, Bm))
+            # demand-driven compact-readback classes (ISSUE 3): each
+            # (W, Bp[, Bm], P) is one program; the serving path reads
+            # back dense until its class lands here
+            from emqx_tpu.models.router_engine import (
+                route_step_cached_compact, route_step_compact,
+                route_window_cached_compact, route_window_full_compact)
+            for Wp, Bp, Bm, P in compact_missing:
+                label = f"warm W{Wp}xB{Bp}" \
+                    + (f"mB{Bm}" if Bm is not None else "") + f"c{P}"
+                ctx = tele.compile_context(label) \
+                    if tele is not None else contextlib.nullcontext()
+                with ctx:
+                    if Bm is None:
+                        enc = np.zeros((Wp, Bp, self.max_levels),
+                                       np.int32)
+                        z = np.zeros((Wp, Bp), np.int32)
+                        zb = np.zeros((Wp, Bp), bool)
+                        if backend == "shapes":
+                            r = route_window_full_compact(
+                                tables, cursors, enc, z, zb, z, strat,
+                                fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap, payload_cap=P)
+                        else:   # trie compact plans are single-batch
+                            r = route_step_compact(
+                                tables, cursors, enc[0], z[0], zb[0],
+                                z[0], strat,
+                                frontier_cap=self.frontier_cap,
+                                match_cap=self.match_cap,
+                                fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap, payload_cap=P)
+                    else:
+                        args = (np.full((Bm, self.max_levels), I.PAD,
+                                        np.int32),
+                                np.zeros(Bm, np.int32),
+                                np.zeros(Bm, bool),
+                                np.full((Bp, match_width), -1, np.int32),
+                                np.zeros(Bp, np.int32),
+                                np.zeros(Bp, bool),
+                                np.full(Bm, Bp, np.int32))
+                        if backend == "shapes":
+                            r = route_window_cached_compact(
+                                tables, cursors, *args,
+                                np.zeros((Wp, Bp), np.int32),
+                                np.zeros((Wp, Bp), np.int32), strat,
+                                fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap, payload_cap=P)
+                        else:
+                            r = route_step_cached_compact(
+                                tables, cursors, *args,
+                                np.zeros(Bp, np.int32),
+                                np.zeros(Bp, np.int32), strat,
+                                frontier_cap=self.frontier_cap,
+                                match_cap=self.match_cap,
+                                fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap, payload_cap=P)
+                    jax.block_until_ready(r.compact.offsets)
+                self._warm_classes.add(
+                    (sig, Wp, Bp)
+                    + ((Bm,) if Bm is not None else ()) + (f"c{P}",))
 
         async def run():
             try:
@@ -1147,6 +1342,12 @@ class DeviceRouteEngine:
         if self.dedup:
             h.plan, h.cache_info = self._plan_window(b, enc4, len4, dol4,
                                                      gate_cold)
+        if not (b.backend != "shapes" and Wp > 1 and h.plan is None):
+            # CSR readback class for this dispatch (None = dense). The
+            # excluded case is the rare plain multi-batch trie window,
+            # which dispatches sequential steps and stacks host-side —
+            # no single fused program to hang the compaction on.
+            h.pcap = self._gate_compact(Wp, Bp, h.plan, gate_cold)
         self._outstanding += 1
         self.node.metrics.inc("routing.device.windows")
         self.node.metrics.inc("routing.device.window_subs", W)
@@ -1238,10 +1439,11 @@ class DeviceRouteEngine:
         return [(id(m) >> 4) & 0x7FFFFFFF for m in msgs]  # random
 
     def _dispatch_inner(self, h) -> None:
-        from emqx_tpu.models.router_engine import (route_step,
-                                                   route_step_cached,
-                                                   route_window_cached,
-                                                   route_window_full)
+        from emqx_tpu.models.router_engine import (
+            route_step, route_step_cached, route_step_cached_compact,
+            route_step_compact, route_window_cached,
+            route_window_cached_compact, route_window_full,
+            route_window_full_compact)
         from emqx_tpu.ops.shared import (STRATEGIES, STRATEGY_ROUND_ROBIN)
         broker = self.broker
         enc4, len4, dol4 = h.enc
@@ -1252,42 +1454,82 @@ class DeviceRouteEngine:
         for k, (msgs, _w, _t) in enumerate(h.subs):
             msg_hash[k, :len(msgs)] = self._msg_hashes(msgs, strat_id)
         p = h.plan
+        P = h.pcap
+        cres = None
 
         if h.built.backend == "shapes":
             if p is not None:
                 # deduplicated dispatch: shape-hash only the miss lanes,
                 # merge with the cache-hit base rows, scatter back to
                 # window width before the cursor-dependent post stage
-                res = route_window_cached(
-                    self._tables, self._cursors, p.miss_topics,
-                    p.miss_lens, p.miss_dollar, p.base_m, p.base_c,
-                    p.base_o, p.miss_pos, p.inv, msg_hash,
-                    np.int32(strat_id), fanout_cap=self.fanout_cap,
-                    slot_cap=self.slot_cap)
-                self._warm_classes.add((self._cur_sig, Wp, Bp, p.Bm))
+                args = (self._tables, self._cursors, p.miss_topics,
+                        p.miss_lens, p.miss_dollar, p.base_m, p.base_c,
+                        p.base_o, p.miss_pos, p.inv, msg_hash,
+                        np.int32(strat_id))
+                kw = dict(fanout_cap=self.fanout_cap,
+                          slot_cap=self.slot_cap)
+                if P is not None:
+                    cres = route_window_cached_compact(*args, **kw,
+                                                       payload_cap=P)
+                    res = cres.res
+                else:
+                    res = route_window_cached(*args, **kw)
+                self._warm_classes.add(
+                    (self._cur_sig, Wp, Bp, p.Bm)
+                    + ((f"c{P}",) if P is not None else ()))
                 self.node.metrics.inc("routing.device.cached_windows")
             else:
-                res = route_window_full(
-                    self._tables, self._cursors, enc4, len4, dol4,
-                    msg_hash, np.int32(strat_id),
-                    fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
-                self._warm_classes.add((self._cur_sig, Wp, Bp))
+                args = (self._tables, self._cursors, enc4, len4, dol4,
+                        msg_hash, np.int32(strat_id))
+                kw = dict(fanout_cap=self.fanout_cap,
+                          slot_cap=self.slot_cap)
+                if P is not None:
+                    cres = route_window_full_compact(*args, **kw,
+                                                     payload_cap=P)
+                    res = cres.res
+                else:
+                    res = route_window_full(*args, **kw)
+                self._warm_classes.add(
+                    (self._cur_sig, Wp, Bp)
+                    + ((f"c{P}",) if P is not None else ()))
             self._cursors = res.new_cursors[-1]
         elif p is not None:
             # trie + plan: single-batch only (_plan_window guarantees
             # Wp == 1 — the trie backend never fuses)
-            import jax.numpy as jnp
-            r = route_step_cached(
-                self._tables, self._cursors, p.miss_topics, p.miss_lens,
-                p.miss_dollar, p.base_m, p.base_c, p.base_o, p.miss_pos,
-                p.inv[0], msg_hash[0], np.int32(strat_id),
-                frontier_cap=self.frontier_cap, match_cap=self.match_cap,
-                fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
-            self._cursors = r.new_cursors
-            self._warm_classes.add((self._cur_sig, Wp, Bp, p.Bm))
+            args = (self._tables, self._cursors, p.miss_topics,
+                    p.miss_lens, p.miss_dollar, p.base_m, p.base_c,
+                    p.base_o, p.miss_pos, p.inv[0], msg_hash[0],
+                    np.int32(strat_id))
+            kw = dict(frontier_cap=self.frontier_cap,
+                      match_cap=self.match_cap,
+                      fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
+            if P is not None:
+                cres = route_step_cached_compact(*args, **kw,
+                                                 payload_cap=P)
+                res = cres.res          # already window-shaped (W = 1)
+                self._cursors = res.new_cursors[-1]
+            else:
+                import jax.numpy as jnp
+                r = route_step_cached(*args, **kw)
+                self._cursors = r.new_cursors
+                res = type(r)(*[jnp.stack([getattr(r, f)])
+                                for f in r._fields])
+            self._warm_classes.add(
+                (self._cur_sig, Wp, Bp, p.Bm)
+                + ((f"c{P}",) if P is not None else ()))
             self.node.metrics.inc("routing.device.cached_windows")
-            res = type(r)(*[jnp.stack([getattr(r, f)])
-                            for f in r._fields])
+        elif P is not None:
+            # plain trie step + fused CSR (single-batch; prepare_window
+            # never assigns a payload class to a multi-batch trie window)
+            cres = route_step_compact(
+                self._tables, self._cursors, enc4[0], len4[0], dol4[0],
+                msg_hash[0], np.int32(strat_id),
+                frontier_cap=self.frontier_cap, match_cap=self.match_cap,
+                fanout_cap=self.fanout_cap, slot_cap=self.slot_cap,
+                payload_cap=P)
+            res = cres.res              # window-shaped (W = 1)
+            self._cursors = res.new_cursors[-1]
+            self._warm_classes.add((self._cur_sig, Wp, Bp, f"c{P}"))
         else:
             # trie backend has no window variant: dispatch sub-batches
             # sequentially (rare path — >SHAPE_CAP distinct shapes)
@@ -1306,26 +1548,88 @@ class DeviceRouteEngine:
                                             for o in outs])
                                   for f in outs[0]._fields])
         h.res = res
+        h.cres = cres.compact if cres is not None else None
 
     def materialize(self, h) -> None:
         """Stage 3 (executor thread): blocking device→host readbacks.
         Every field is [W, ...] (window-stacked). Also the match-cache
         population point: the rows for this window's cache-missed unique
         topics come straight out of the readback the consume stage needs
-        anyway — no extra device round trip."""
+        anyway — no extra device round trip.
+
+        With a payload class attached (h.cres — ISSUE 3) the transfer is
+        the CSR planes (offsets + counts3 + flat payload) plus the small
+        overflow/occur planes, instead of the padded match/fan-out/shared
+        planes: >90% of the dense transfer is `-1` padding at low
+        fan-out. A window whose entries outgrew its payload class reads
+        the dense planes of the SAME dispatch instead (they are outputs
+        of the same fused program — the fallback re-dispatches nothing).
+        Both paths meter actual transferred bytes into the
+        pipeline.readback.* counters all four exporters carry."""
         tele = getattr(self.node, "pipeline_telemetry", None)
+        metrics = self.node.metrics
         t0 = time.perf_counter()
         res = h.res
+        cp = h.cres
+        csr_probe_bytes = 0
+        if cp is not None:
+            off = np.asarray(cp.offsets)
+            c3 = np.asarray(cp.counts3)
+            rovf = np.asarray(cp.row_overflow)
+            # EWMA learns from the offsets either way — on the overflow
+            # fallback the totals are exactly what resizes the class up
+            self._note_payload(off.shape[1] - 1, off[:, -1])
+            if rovf.any():
+                metrics.inc("routing.device.compact_overflow")
+                # the CSR probe planes already crossed the link; bill
+                # them to the dense window below or the exported
+                # reduction overstates exactly the overflowing workloads
+                csr_probe_bytes = off.nbytes + c3.nbytes + rovf.nbytes
+                h.cres = None           # dense readback below
+            else:
+                overflow = np.asarray(res.overflow)
+                occur = np.asarray(res.occur)
+                pay = np.asarray(cp.payload)
+                h.np_res = _CsrRes(off, c3, pay, overflow, occur)
+                metrics.inc("pipeline.readback.bytes.compact",
+                            off.nbytes + c3.nbytes + pay.nbytes
+                            + overflow.nbytes + occur.nbytes)
+                metrics.inc("pipeline.readback.windows.compact")
+                info = h.cache_info
+                if info is not None and self._match_cache is not None:
+                    # cache population from the CSR view: a reconstructed
+                    # row is the hole-compacted valid prefix + -1 pad.
+                    # Equivalent to the dense row by the hole-insensitivity
+                    # contract (ops/compact.py): fan-out/shared expansion
+                    # and consume only see valid entries in order, and the
+                    # stored count cm == match_counts for both backends.
+                    mw = h.built.match_width
+                    Bp = off.shape[1] - 1
+                    o_flat = overflow.reshape(-1)
+                    items = []
+                    for key, lane in info.inserts:
+                        w, bb = divmod(lane, Bp)
+                        cm = int(c3[w, bb, 0])
+                        row = np.full(mw, -1, np.int32)
+                        row[:cm] = pay[w, off[w, bb]:off[w, bb] + cm]
+                        items.append((key, (row, cm, bool(o_flat[lane]))))
+                    self._match_cache.put_many(info.sid, items)
+                if tele is not None:
+                    tele.observe_stage("materialize",
+                                       time.perf_counter() - t0)
+                return
         h.np_res = (np.asarray(res.matches), np.asarray(res.rows),
                     np.asarray(res.opts), np.asarray(res.shared_sids),
                     np.asarray(res.shared_rows), np.asarray(res.shared_opts),
                     np.asarray(res.overflow), np.asarray(res.occur))
+        dense_bytes = sum(a.nbytes for a in h.np_res) + csr_probe_bytes
         info = h.cache_info
         if info is not None and self._match_cache is not None:
             # the match_counts readback is only paid when there are rows
             # to insert — consume never reads it, so windows with no
             # cache work skip the extra [W, B] transfer entirely
             h.np_counts = np.asarray(res.match_counts)
+            dense_bytes += h.np_counts.nbytes
             matches, overflow = h.np_res[0], h.np_res[6]
             mw = matches.shape[-1]
             mflat = matches.reshape(-1, mw)
@@ -1339,6 +1643,8 @@ class DeviceRouteEngine:
                 info.sid,
                 [(k, (mflat[i].copy(), int(cflat[i]), bool(oflat[i])))
                  for k, i in info.inserts])
+        metrics.inc("pipeline.readback.bytes.dense", dense_bytes)
+        metrics.inc("pipeline.readback.windows.dense")
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
 
@@ -1358,31 +1664,51 @@ class DeviceRouteEngine:
         tele = getattr(self.node, "pipeline_telemetry", None)
         t0 = time.perf_counter()
         try:
-            (matches, rows, opts, shared_sids, shared_rows, shared_opts,
-             overflow, occur) = h.np_res
+            nr = h.np_res
             msgs, words_list, too_long = h.subs[k]
             b = h.built
+            csr = isinstance(nr, _CsrRes)
+            if csr:
+                overflow_k, occur_k = nr.overflow[k], nr.occur[k]
+            else:
+                (matches, rows, opts, shared_sids, shared_rows,
+                 shared_opts, overflow, occur) = nr
+                overflow_k, occur_k = overflow[k], occur[k]
             if h.dev_shared and b.n_slots:
-                self._writeback_cursors(occur[k], b)
+                self._writeback_cursors(occur_k, b)
             metrics = self.node.metrics
             broker = self.broker
-            fast = self._consume_batch_fast(
-                msgs, matches[k], rows[k], opts[k], shared_sids[k],
-                too_long, overflow[k], h.dev_shared, b)
+            if csr:
+                fast = self._consume_batch_fast_csr(
+                    msgs, nr.off[k], nr.c3[k], nr.pay[k], too_long,
+                    overflow_k, h.dev_shared, b)
+            else:
+                fast = self._consume_batch_fast(
+                    msgs, matches[k], rows[k], opts[k], shared_sids[k],
+                    too_long, overflow_k, h.dev_shared, b)
             counts: list[int] = []
             for i, msg in enumerate(msgs):
                 if fast[i] is not None:
                     counts.append(fast[i])
                     continue
-                if too_long[i] or overflow[k][i]:
+                if too_long[i] or overflow_k[i]:
                     metrics.inc("routing.device.host_fallback")
                     counts.append(broker._route(
                         msg, self.router.match(msg.topic)))
                     continue
+                if csr:
+                    # per-message CSR views: the valid entries of every
+                    # plane in order, no pad — _consume_one's walk is
+                    # layout-agnostic (it skips -1 and slices fan rows
+                    # by the built segment lengths, which the payload's
+                    # fan section concatenates exactly)
+                    row6 = csr_slices(nr.off[k], nr.c3[k], nr.pay[k], i)
+                else:
+                    row6 = (matches[k][i], rows[k][i], opts[k][i],
+                            shared_sids[k][i], shared_rows[k][i],
+                            shared_opts[k][i])
                 counts.append(self._consume_one(
-                    msg, matches[k][i], rows[k][i], opts[k][i],
-                    shared_sids[k][i], shared_rows[k][i],
-                    shared_opts[k][i],
+                    msg, *row6,
                     words_list[i] if words_list is not None else None,
                     h.dev_shared, b))
             metrics.inc("routing.device.batches")
@@ -1402,15 +1728,64 @@ class DeviceRouteEngine:
         no dirty/rich matched filter, and no shared involvement (no
         device slot matched; no matched filter with host shared
         groups)."""
-        broker = self.broker
-        if (broker.cluster is not None or self._delta_filter
+        if (self.broker.cluster is not None or self._delta_filter
                 or self.new_slots_by_filter):
             return [None] * len(msgs)
         B = len(msgs)
         mask = m_k[:B] >= 0
         mi = np.nonzero(mask)[0]
         fids = m_k[:B][mask]
+        shared_any = (ss_k[:B] >= 0).any(axis=1)
 
+        def fetch(row_msg, col):
+            return r_k[row_msg, col], o_k[row_msg, col]
+
+        return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
+                                  shared_any, fetch, dev_shared, b)
+
+    def _consume_batch_fast_csr(self, msgs, off_k, c3_k, pay_k, too_long,
+                                overflow_k, dev_shared: bool, b):
+        """_consume_batch_fast over one window row's CSR planes: same
+        clean-message proof and the same vectorized delivery walk, with
+        the 2-D plane gathers replaced by flat payload gathers at each
+        message's family base offsets."""
+        if (self.broker.cluster is not None or self._delta_filter
+                or self.new_slots_by_filter):
+            return [None] * len(msgs)
+        B = len(msgs)
+        cm = c3_k[:B, 0].astype(np.int64)
+        cf = c3_k[:B, 1].astype(np.int64)
+        cs = c3_k[:B, 2]
+        base = off_k[:B].astype(np.int64)
+        total_m = int(cm.sum())
+        mi = np.repeat(np.arange(B), cm)
+        if total_m:
+            mcum = np.cumsum(cm) - cm
+            fids = pay_k[np.arange(total_m) - np.repeat(mcum, cm)
+                         + np.repeat(base, cm)]
+        else:
+            fids = np.zeros(0, np.int32)
+        shared_any = cs[:B] > 0
+        fbase = base + cm           # fan rows start, per message
+        obase = base + cm + cf      # fan opts start, per message
+
+        def fetch(row_msg, col):
+            return (pay_k[fbase[row_msg] + col],
+                    pay_k[obase[row_msg] + col])
+
+        return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
+                                  shared_any, fetch, dev_shared, b)
+
+    def _fast_deliver(self, msgs, mi, fids, too_long, overflow_k,
+                      shared_any, fetch, dev_shared: bool, b):
+        """Shared tail of the vectorized fast consume (dense and CSR):
+        per-message clean proof, row attribution, delivery, and the
+        no-subscriber bookkeeping. `mi`/`fids` list every valid match
+        (message index, filter id) in match order; `fetch(row_msg, col)`
+        gathers the (sid, packed opts) of fan-out entry `col` within
+        message `row_msg`."""
+        broker = self.broker
+        B = len(msgs)
         # per-fid host-side mask: rich is snapshot-constant (precomputed
         # at build); only the usually-empty dirty set costs per-batch work
         hostside = b.fid_rich
@@ -1425,7 +1800,7 @@ class DeviceRouteEngine:
         if fids.size:
             np.logical_or.at(slow, mi, hostside[fids] | b.fid_shared[fids])
         if dev_shared:
-            slow |= (ss_k[:B] >= 0).any(axis=1)
+            slow |= shared_any
 
         out: list = [None] * B
         fast_ok = ~slow
@@ -1450,8 +1825,7 @@ class DeviceRouteEngine:
             row_local = ar - np.repeat(csum, seg)
             col = np.repeat(within, seg) + row_local
             row_fid = np.repeat(fids_f, seg)
-            sid = r_k[row_msg, col]
-            opt = o_k[row_msg, col]
+            sid, opt = fetch(row_msg, col)
             valid = sid >= 0
             fid_filter = b.fid_filter
             deliver = broker._deliver
@@ -1706,4 +2080,7 @@ class DeviceRouteEngine:
             "dedup": self.dedup,
             "match_cache": self._match_cache.stats()
             if self._match_cache is not None else None,
+            "compact_readback": self.compact_readback,
+            "payload_ewma": {k: round(v, 1)
+                             for k, v in self._pay_ewma.items()},
         }

@@ -16,6 +16,17 @@ key set, ~2^-128 per pair). Rows are numpy: matches [Mw] int32, count
 int32, overflow bool, where Mw is the snapshot's match width (shape
 capacity for the shapes backend, match_cap for the trie NFA).
 
+Row layout note (ISSUE 3): rows populated from a COMPACTED readback
+(device_engine.materialize's CSR branch) are hole-compacted — the valid
+filter ids as a prefix, -1 beyond — while a dense readback preserves the
+shape-slot hole positions of the shapes backend. The two layouts are
+interchangeable by the hole-insensitivity contract (ops/compact.py):
+fan-out/shared expansion treat -1 as a zero-length segment and consume
+skips it, the valid ids keep their match ORDER either way, and `count`
+equals the true match count for both. Deliveries and cursor threading
+are therefore bit-identical regardless of which readback populated a
+row (oracle-tested in tests/test_compact_readback.py).
+
 Consistency invariant (why per-snapshot keying suffices): mutations
 never edit the device tables in place — subscription churn marks
 filters/slots dirty and those serve host-side against the PINNED

@@ -69,7 +69,8 @@ class Node:
                 self, n_devices=mc.get("devices"), dp=mc.get("dp"),
                 fanout_cap=perf.get("device_fanout_cap", 128),
                 slot_cap=perf.get("device_slot_cap", 16),
-                max_batch=mc.get("max_batch", 256))
+                max_batch=mc.get("max_batch", 256),
+                compact_readback=perf.get("compact_readback"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
@@ -86,7 +87,10 @@ class Node:
                 # device-match reuse layers (None = env / built-in
                 # default; see EMQX_TPU_MATCH_CACHE / EMQX_TPU_DEDUP)
                 match_cache_size=perf.get("match_cache_size"),
-                dedup=perf.get("topic_dedup"))
+                dedup=perf.get("topic_dedup"),
+                # CSR readback compaction A/B knob (ISSUE 3; None =
+                # EMQX_TPU_COMPACT_READBACK / default-on)
+                compact_readback=perf.get("compact_readback"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),

@@ -256,7 +256,9 @@ class PipelineTelemetry:
             if k.startswith("pipeline.batches.")}
         for extra in ("routing.device.bypassed", "routing.device.cold_class",
                       "routing.device.cold_cached_class",
+                      "routing.device.cold_compact_class",
                       "routing.device.cached_windows",
+                      "routing.device.compact_overflow",
                       "routing.device.host_fallback",
                       "routing.device.dispatch_failed",
                       "pipeline.slow_batches"):
@@ -282,6 +284,28 @@ class PipelineTelemetry:
             uniq = self.metrics.val("routing.dedup.unique")
             dedup = {"lanes": lanes, "unique": uniq,
                      "ratio": round(1.0 - uniq / lanes, 4)}
+        # device→host readback accounting (ISSUE 3): actual transferred
+        # bytes per path. `reduction` compares the two paths' measured
+        # per-window byte costs — the compaction win the acceptance
+        # criteria grade, derived here once for every exporter/bench
+        readback = {}
+        for k in ("bytes.dense", "bytes.compact",
+                  "windows.dense", "windows.compact"):
+            v = self.metrics.val(f"pipeline.readback.{k}")
+            if v:
+                readback[k.replace(".", "_")] = v
+        cw, dw = readback.get("windows_compact"), \
+            readback.get("windows_dense")
+        if cw:
+            readback["bytes_per_window_compact"] = round(
+                readback.get("bytes_compact", 0) / cw)
+        if dw:
+            readback["bytes_per_window_dense"] = round(
+                readback.get("bytes_dense", 0) / dw)
+        if cw and dw and readback["bytes_per_window_compact"]:
+            readback["reduction"] = round(
+                readback["bytes_per_window_dense"]
+                / readback["bytes_per_window_compact"], 2)
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -293,6 +317,8 @@ class PipelineTelemetry:
             out["match_cache"] = cache
         if dedup:
             out["dedup"] = dedup
+        if readback:
+            out["readback"] = readback
         jc = _jit_cache_sizes()
         if jc:
             out["jit_cache"] = jc

@@ -167,6 +167,131 @@ def route_window_cached(tables: ShapeRouterTables, cursors: jax.Array,
     return stacked
 
 
+class CompactRouteResult(NamedTuple):
+    """A route result with its fused CSR readback (ops.compact).
+
+    `res` carries the FULL window-stacked dense planes — they are
+    intermediates of the same program, so returning them costs nothing;
+    the host reads them back only when `compact.row_overflow` fires
+    (payload class too small for this window) — the dense fallback needs
+    no re-dispatch. Every per-topic plane in `res` is window-shaped
+    ([W, ...]) for ALL variants, including the single-batch trie steps
+    (W = 1), so the consume path is uniform."""
+    res: RouteResult
+    compact: "CompactPlanes"  # noqa: F821 — imported lazily below
+
+
+def _with_compact(r: RouteResult, payload_cap: int,
+                  match_holes: bool) -> CompactRouteResult:
+    """match_holes=True for the shape-hash backend (matches carry
+    interior holes at unmatched shape slots), False for the trie NFA
+    (emissions are densely packed already — the hole-closing stage
+    compiles away). The engine's window variants are shapes-only and
+    the step variants trie-only, so each hardcodes its flag."""
+    from emqx_tpu.ops.compact import compact_result
+    cp = compact_result(r.matches, r.rows, r.opts, r.fan_counts,
+                        r.shared_sids, r.shared_rows, r.shared_opts,
+                        payload_cap=payload_cap, match_holes=match_holes)
+    return CompactRouteResult(res=r, compact=cp)
+
+
+def _stack1(r: RouteResult) -> RouteResult:
+    """Lift a single-batch RouteResult to window form (W = 1)."""
+    return RouteResult(*[x[None] for x in r])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "payload_cap"))
+def route_step_compact(tables: RouterTables, cursors: jax.Array,
+                       topics: jax.Array, lens: jax.Array,
+                       is_dollar: jax.Array, msg_hash: jax.Array,
+                       strategy: jax.Array, *, frontier_cap: int = 16,
+                       match_cap: int = 64, fanout_cap: int = 128,
+                       slot_cap: int = 16,
+                       payload_cap: int = 4096) -> CompactRouteResult:
+    """Trie-NFA route step with the fused CSR readback (window-shaped)."""
+    r = route_step(tables, cursors, topics, lens, is_dollar, msg_hash,
+                   strategy, frontier_cap=frontier_cap,
+                   match_cap=match_cap, fanout_cap=fanout_cap,
+                   slot_cap=slot_cap)
+    return _with_compact(_stack1(r), payload_cap, match_holes=False)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "payload_cap"))
+def route_step_cached_compact(tables: RouterTables, cursors: jax.Array,
+                              miss_topics: jax.Array,
+                              miss_lens: jax.Array,
+                              miss_dollar: jax.Array,
+                              base_matches: jax.Array,
+                              base_counts: jax.Array,
+                              base_overflow: jax.Array,
+                              miss_pos: jax.Array, inv: jax.Array,
+                              msg_hash: jax.Array, strategy: jax.Array,
+                              *, frontier_cap: int = 16,
+                              match_cap: int = 64, fanout_cap: int = 128,
+                              slot_cap: int = 16,
+                              payload_cap: int = 4096
+                              ) -> CompactRouteResult:
+    """Deduplicated trie step + fused CSR readback (window-shaped)."""
+    r = route_step_cached(tables, cursors, miss_topics, miss_lens,
+                          miss_dollar, base_matches, base_counts,
+                          base_overflow, miss_pos, inv, msg_hash,
+                          strategy, frontier_cap=frontier_cap,
+                          match_cap=match_cap, fanout_cap=fanout_cap,
+                          slot_cap=slot_cap)
+    return _with_compact(_stack1(r), payload_cap, match_holes=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fanout_cap", "slot_cap",
+                                    "payload_cap"))
+def route_window_full_compact(tables: ShapeRouterTables,
+                              cursors: jax.Array, topics: jax.Array,
+                              lens: jax.Array, is_dollar: jax.Array,
+                              msg_hash: jax.Array, strategy: jax.Array,
+                              *, fanout_cap: int = 128,
+                              slot_cap: int = 16,
+                              payload_cap: int = 4096
+                              ) -> CompactRouteResult:
+    """route_window_full + fused CSR readback in the same dispatch."""
+    r = route_window_full(tables, cursors, topics, lens, is_dollar,
+                          msg_hash, strategy, fanout_cap=fanout_cap,
+                          slot_cap=slot_cap)
+    return _with_compact(r, payload_cap, match_holes=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fanout_cap", "slot_cap",
+                                    "payload_cap"))
+def route_window_cached_compact(tables: ShapeRouterTables,
+                                cursors: jax.Array,
+                                miss_topics: jax.Array,
+                                miss_lens: jax.Array,
+                                miss_dollar: jax.Array,
+                                base_matches: jax.Array,
+                                base_counts: jax.Array,
+                                base_overflow: jax.Array,
+                                miss_pos: jax.Array, inv: jax.Array,
+                                msg_hash: jax.Array,
+                                strategy: jax.Array, *,
+                                fanout_cap: int = 128,
+                                slot_cap: int = 16,
+                                payload_cap: int = 4096
+                                ) -> CompactRouteResult:
+    """route_window_cached + fused CSR readback in the same dispatch."""
+    r = route_window_cached(tables, cursors, miss_topics, miss_lens,
+                            miss_dollar, base_matches, base_counts,
+                            base_overflow, miss_pos, inv, msg_hash,
+                            strategy, fanout_cap=fanout_cap,
+                            slot_cap=slot_cap)
+    return _with_compact(r, payload_cap, match_holes=True)
+
+
 def route_digest(r: RouteResult) -> jax.Array:
     """Scalar int32 reduction over EVERY RouteResult output plane.
 
@@ -248,7 +373,9 @@ def compile_stats() -> dict[str, int]:
     `GET /api/v5/pipeline/stats` and the bench telemetry snapshot."""
     out = {}
     for fn in (route_step, route_step_shapes, route_window_shapes,
-               route_window_full, route_step_cached, route_window_cached):
+               route_window_full, route_step_cached, route_window_cached,
+               route_step_compact, route_step_cached_compact,
+               route_window_full_compact, route_window_cached_compact):
         try:
             out[fn.__name__] = fn._cache_size()
         except Exception:  # noqa: BLE001 — cache introspection is best-effort

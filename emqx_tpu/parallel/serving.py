@@ -56,6 +56,7 @@ from emqx_tpu.broker.device_engine import (_REMOTE_SID_BASE, _is_rich,
                                            _unpack_opts, capture_shared)
 from emqx_tpu.broker.message import Message
 from emqx_tpu.ops import intern as I
+from emqx_tpu.ops.compact import csr_slices
 from emqx_tpu.utils import topic as T
 
 
@@ -108,7 +109,8 @@ class ShardedRouteServer:
                  dp: Optional[int] = None, mesh=None,
                  frontier_cap: int = 16, match_cap: int = 64,
                  fanout_cap: int = 128, slot_cap: int = 16,
-                 level_cap: int = 16, max_batch: int = 256):
+                 level_cap: int = 16, max_batch: int = 256,
+                 compact_readback: Optional[bool] = None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -155,6 +157,23 @@ class ShardedRouteServer:
         self._capture_gen = 0
         self._rebuild_backoff_until = 0.0
         self._lock = threading.Lock()   # dispatch thread vs loop rebuilds
+
+        # CSR readback compaction (ISSUE 3), mesh edition: unlike the
+        # single-chip engine the compaction is a SECOND small jitted
+        # call in materialize (the mesh is co-located — launch cost is
+        # microseconds, not a relay round trip), run over the stacked
+        # [B, R, ...] planes reshaped to one [1, B*R] pseudo-window.
+        # Payload classes are (Bp, P) keyed — independent of the
+        # capacity classes, so they survive rebuilds — warmed by the
+        # same background thread as the batch classes.
+        if compact_readback is None:
+            from emqx_tpu.broker.device_engine import _ENV_COMPACT
+            compact_readback = _ENV_COMPACT
+        self.compact_readback = bool(compact_readback)
+        self._payload_mults = (8, 32, 128)
+        self._pay_ewma: Optional[float] = None
+        self._compact_warm: set[tuple] = set()    # {(Bp, P)}
+        self._wanted_pcap: set[tuple] = set()
 
         # engine wiring (same hooks DeviceRouteEngine claims)
         self.broker.device_engine = self
@@ -519,12 +538,25 @@ class ShardedRouteServer:
             while Bp <= self.max_batch:
                 classes.append(Bp)
                 Bp *= 2
-            for _ in range(8 * len(classes)):   # bounded self-heal
+            for _ in range(8 * (len(classes) + 4)):   # bounded self-heal
+                if self._builts is None:
+                    return
                 missing = [c for c in classes
                            if c not in self._warm_classes]
-                if not missing or self._builts is None:
+                # demand-registered compact readback classes re-run the
+                # (cached) step for their Bp and compact ITS result, so
+                # the compaction compiles against the step outputs'
+                # actual shardings/dtypes (a numpy dummy would warm the
+                # wrong program variant). list() first: materialize on
+                # the executor thread .add()s concurrently, and a set
+                # comprehension over the live set is a bytecode-level
+                # iteration that would raise changed-size-during-iter
+                # and kill the warm pass (list(set) is one atomic C call)
+                want_c = sorted({bq for bq, P in list(self._wanted_pcap)
+                                 if (bq, P) not in self._compact_warm})
+                if not missing and not want_c:
                     return
-                self._warm_one(missing[0])
+                self._warm_one((missing + want_c)[0])
 
         self._warm_thread = threading.Thread(target=warm, daemon=True)
         self._warm_thread.start()
@@ -549,6 +581,24 @@ class ShardedRouteServer:
         with self._lock:
             if self._caps == caps:      # signature still current
                 self._warm_classes.add(Bp)
+        # wanted compact classes for this Bp compile against the step's
+        # own outputs (right shardings); keyed (Bp, P) only — payload
+        # classes are capacity-signature independent
+        from emqx_tpu.ops.compact import compact_planes_jit
+        # sorted() snapshots the set in one atomic C call — safe against
+        # concurrent materialize-side .add()s
+        for bq, P in sorted(self._wanted_pcap):
+            if bq != Bp or (Bp, P) in self._compact_warm:
+                continue
+            cw = tele.compile_context(f"warm mesh B{Bp}c{P}") \
+                if tele is not None else contextlib.nullcontext()
+            with cw:
+                cp = compact_planes_jit(
+                    res.matches, res.rows, res.opts, res.fan_counts,
+                    res.shared_sids, res.shared_rows, res.shared_opts,
+                    payload_cap=P, match_holes=False)
+                jax.block_until_ready(cp.offsets)
+            self._compact_warm.add((Bp, P))
 
     def max_fuse(self) -> int:
         return 1        # no window fusion on the mesh path (yet)
@@ -629,11 +679,87 @@ class ShardedRouteServer:
         if tele is not None:
             tele.observe_stage("dispatch", time.perf_counter() - t0)
 
+    def _choose_pcap(self, Bp: int) -> Optional[int]:
+        """Payload class for a Bp-wide mesh readback, or None for dense.
+        Same peak-biased-EWMA + pow2-multiple-ladder scheme as the
+        single-chip engine (device_engine._choose_payload_cap); entry
+        totals sum over shards, so the ladder multiplies Bp, not Bp*R."""
+        if not self.compact_readback:
+            return None
+        dense = self.match_cap + 2 * self.fanout_cap + 3 * self.slot_cap
+        mults = [m for m in self._payload_mults if m < dense]
+        if not mults:
+            return None
+        ew = self._pay_ewma
+        if ew is None:
+            return mults[min(1, len(mults) - 1)] * Bp
+        for m in mults:
+            if m * Bp >= 2.0 * ew:
+                return m * Bp
+        return None
+
+    def _note_payload(self, total: float) -> None:
+        ew = self._pay_ewma
+        self._pay_ewma = total if (ew is None or total > ew) \
+            else 0.8 * ew + 0.2 * total
+
     def materialize(self, h: _Handle) -> None:
-        """Stage 3 (executor thread): device → host readbacks."""
+        """Stage 3 (executor thread): device → host readbacks.
+
+        With compaction on (ISSUE 3) the [B, R, ...] result planes are
+        compacted by a second small jitted call into one [1, B*R] CSR
+        payload (lane = i*R + r) and only offsets + actual entries cross
+        to the host; the small overflow/occur planes ride along either
+        way. A window outgrowing its payload class reads the dense
+        planes instead (row_overflow) — correctness never depends on the
+        class fitting. Bytes transferred land in pipeline.readback.*."""
         tele = getattr(self.node, "pipeline_telemetry", None)
+        metrics = self.node.metrics
         t0 = time.perf_counter()
         r = h.res
+        Bp = int(r.matches.shape[0])
+        P = self._choose_pcap(Bp)
+        if P is not None and (Bp, P) not in self._compact_warm:
+            # cold compact class: dense this batch, background-warm it
+            # (materialize runs off-loop, but an in-path XLA compile
+            # would still stall this batch's pipeline slot for seconds)
+            self._wanted_pcap.add((Bp, P))
+            self._kick_class_warm()
+            metrics.inc("routing.device.cold_compact_class")
+            P = None
+        csr_probe_bytes = 0
+        if P is not None:
+            from emqx_tpu.ops.compact import compact_planes_jit
+            # match_holes=False: the mesh step is trie-backed (its NFA
+            # emissions are densely packed, never hole-y like shapes)
+            cp = compact_planes_jit(
+                r.matches, r.rows, r.opts, r.fan_counts, r.shared_sids,
+                r.shared_rows, r.shared_opts, payload_cap=P,
+                match_holes=False)
+            off = np.asarray(cp.offsets)[0]
+            c3 = np.asarray(cp.counts3)[0]
+            rovf = np.asarray(cp.row_overflow)
+            self._note_payload(float(off[-1]))
+            if rovf.any():
+                metrics.inc("routing.device.compact_overflow")
+                # the CSR probe planes already crossed: bill them to the
+                # dense window below so the exported reduction stays
+                # honest on overflowing workloads
+                csr_probe_bytes = off.nbytes + c3.nbytes + rovf.nbytes
+            else:
+                pay = np.asarray(cp.payload)[0]
+                overflow = np.asarray(r.overflow)
+                occur = np.asarray(r.occur)
+                h.np_res = {"csr": (off, c3, pay), "overflow": overflow,
+                            "occur": occur}
+                metrics.inc("pipeline.readback.bytes.compact",
+                            off.nbytes + c3.nbytes + pay.nbytes
+                            + overflow.nbytes + occur.nbytes)
+                metrics.inc("pipeline.readback.windows.compact")
+                if tele is not None:
+                    tele.observe_stage("materialize",
+                                       time.perf_counter() - t0)
+                return
         h.np_res = {
             "matches": np.asarray(r.matches),
             "rows": np.asarray(r.rows), "opts": np.asarray(r.opts),
@@ -643,6 +769,10 @@ class ShardedRouteServer:
             "overflow": np.asarray(r.overflow),
             "occur": np.asarray(r.occur),      # [R, G]
         }
+        metrics.inc("pipeline.readback.bytes.dense",
+                    sum(a.nbytes for a in h.np_res.values())
+                    + csr_probe_bytes)
+        metrics.inc("pipeline.readback.windows.dense")
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
 
@@ -708,12 +838,23 @@ class ShardedRouteServer:
         n = 0
         matched: list[str] = []
         handled: set[tuple] = set()   # (filter, group) the mesh served
+        csr = np_res.get("csr")
         for r in range(self.n_route):
             b = builts[r]
             off = 0
-            row_m = np_res["matches"][i, r]
-            rows = np_res["rows"][i, r]
-            opts = np_res["opts"][i, r]
+            if csr is not None:
+                # CSR lane (i, r) → i*R + r (ops.compact pseudo-window
+                # layout): the valid entries of every plane in order,
+                # no pad — the walks below are layout-agnostic
+                (row_m, rows, opts, srow, prow, orow) = csr_slices(
+                    csr[0], csr[1], csr[2], i * self.n_route + r)
+            else:
+                row_m = np_res["matches"][i, r]
+                rows = np_res["rows"][i, r]
+                opts = np_res["opts"][i, r]
+                srow = np_res["shared_sids"][i, r]
+                prow = np_res["shared_rows"][i, r]
+                orow = np_res["shared_opts"][i, r]
             # fan-out rows are the concatenation of per-filter segments
             # in LOCAL fid order of the matched set
             for fid in row_m:
@@ -739,9 +880,6 @@ class ShardedRouteServer:
                     matched.append(f)
                     n += broker.dispatch(f, msg)
             if dev_shared:
-                srow = np_res["shared_sids"][i, r]
-                prow = np_res["shared_rows"][i, r]
-                orow = np_res["shared_opts"][i, r]
                 for k, slot in enumerate(srow):
                     if slot < 0 or slot >= len(b.slot_key):
                         continue
@@ -765,24 +903,34 @@ class ShardedRouteServer:
                         elif self._host_shared_dispatch(f, gname, msg):
                             n += 1   # cluster torn down since the build
                     elif sid >= 0:
-                        if broker._deliver(
+                        # per-slot staleness guard (ADVICE r5): the pick
+                        # was made against this handle's PINNED shard
+                        # snapshot — if the member left the group
+                        # mid-batch (session may still be alive, so
+                        # _deliver would succeed wrongly) or the shard
+                        # was re-dirtied since, re-pick host-side
+                        # against live membership, mirroring the
+                        # single-chip consume's dirty_slots check
+                        grp = broker.shared.get(f, {}).get(gname)
+                        stale = (grp is None or sid not in grp.members
+                                 or self.shard_of(f) in self.dirty_shards)
+                        if stale:
+                            if self._host_shared_dispatch(f, gname, msg):
+                                n += 1
+                        elif broker._deliver(
                                 sid, f, msg,
                                 dict(_unpack_opts(int(orow[k])),
                                      share=gname)):
                             n += 1
                             metrics.inc("messages.routed.device")
-                        else:
-                            # re-dispatch only when the picked member
-                            # vanished in the in-flight churn window (or
-                            # the ack protocol is on) — a nack from a
-                            # live member with dispatch_ack off is
-                            # final, matching the host pick
-                            grp = broker.shared.get(f, {}).get(gname)
-                            gone = grp is None or sid not in grp.members
-                            if (gone or broker.shared_dispatch_ack) \
-                                    and self._host_shared_dispatch(
-                                        f, gname, msg):
-                                n += 1
+                        elif broker.shared_dispatch_ack \
+                                and self._host_shared_dispatch(
+                                    f, gname, msg):
+                            # nack with the ack protocol on: host
+                            # re-pick (a nack from a live member with
+                            # dispatch_ack off stays final, matching
+                            # the host pick's semantics)
+                            n += 1
         if not dev_shared:
             n += broker._dispatch_shared(msg, matched)
         else:
@@ -867,4 +1015,7 @@ class ShardedRouteServer:
             # per-shard key space on the mesh — explicitly bypassed here
             # (see prepare_window), not merely cold
             "match_cache": "bypassed",
+            "compact_readback": self.compact_readback,
+            "payload_ewma": round(self._pay_ewma, 1)
+            if self._pay_ewma is not None else None,
         }

@@ -36,9 +36,14 @@ def mkmsg(topic, payload=b"x"):
 def _twin_nodes(setup, **engine_over):
     """Two nodes with identical subscription state: `fast` has dedup +
     cache on (default), `plain` has both layers off — the bit-for-bit
-    oracle. `setup(broker) -> sinks` runs against each."""
-    fast = Node()
-    plain = Node(PLAIN_CONF)
+    oracle. `setup(broker) -> sinks` runs against each. Both twins pin
+    the DENSE readback: this oracle compares raw np_res planes, which
+    the CSR readback replaces wholesale; the compact-vs-dense oracle
+    (incl. the dedup/cache interplay) lives in
+    tests/test_compact_readback.py."""
+    fast = Node({"broker": {"compact_readback": False}})
+    plain = Node({"broker": {**PLAIN_CONF["broker"],
+                             "compact_readback": False}})
     assert fast.device_engine.dedup
     assert fast.device_engine._match_cache is not None
     assert not plain.device_engine.dedup

@@ -481,7 +481,11 @@ class TestCompileAccounting:
         st = compile_stats()
         assert set(st) <= {"route_step", "route_step_shapes",
                            "route_window_shapes", "route_window_full",
-                           "route_step_cached", "route_window_cached"}
+                           "route_step_cached", "route_window_cached",
+                           "route_step_compact",
+                           "route_step_cached_compact",
+                           "route_window_full_compact",
+                           "route_window_cached_compact"}
         assert all(isinstance(v, int) for v in st.values())
 
 

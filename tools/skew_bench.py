@@ -18,6 +18,11 @@ node, whose `match_cache` / `dedup` sections carry the hit-rate and
 dedup-ratio counters — so the speedup is attributable to the measured
 reuse rate, not vibes. ISSUE 2 acceptance: speedup >= 2x.
 
+A third engine (reuse layers on, compact readback OFF) grades the
+ISSUE 3 acceptance pair on the same traffic: readback bytes-per-window
+reduction (compact vs dense, >= 4x at this workload's fan-out of 1)
+with no matches/s regression (`compact_vs_dense`).
+
 Env knobs: SKEW_FILTERS (10000), SKEW_BATCH (1024), SKEW_BATCHES (48),
 SKEW_HOT (16), SKEW_HOT_PCT (90), SKEW_ZIPF (0).
 
@@ -44,13 +49,14 @@ class _Sink:
         return True
 
 
-def _mk_node(dedup: bool):
+def _mk_node(dedup: bool, compact: bool = True):
     from emqx_tpu.broker.node import Node
 
     # tight fan-out/slot caps: the bench workload has one subscriber per
     # filter, so generous caps would just pad the post stage and dilute
     # the match-stage difference under test (same trim as bench.py)
     return Node({"broker": {"topic_dedup": dedup,
+                            "compact_readback": compact,
                             "device_fanout_cap": 4,
                             "device_slot_cap": 2}})
 
@@ -148,9 +154,11 @@ def run_skew() -> dict:
     zipf = os.environ.get("SKEW_ZIPF", "0") == "1"
 
     rng = np.random.RandomState(11)
-    fast = _mk_node(dedup=True)
+    fast = _mk_node(dedup=True)                    # compact readback on
+    dense = _mk_node(dedup=True, compact=False)    # ISSUE 3 A/B twin
     plain = _mk_node(dedup=False)
     filters = _subscribe_all(fast, n_filters)
+    _subscribe_all(dense, n_filters)
     _subscribe_all(plain, n_filters)
     log(f"skew bench: {n_filters} filters, "
         f"{'zipf' if zipf else f'{hot_pct}/{100 - hot_pct} hot-set'} "
@@ -160,8 +168,16 @@ def run_skew() -> dict:
                           n_batches)
 
     uncached_ps = _run_engine(plain, batches, "uncached")
-    cached_ps = _run_engine(fast, batches, "cached")
+    dense_ps = _run_engine(dense, batches, "cached+dense")
+    cached_ps = _run_engine(fast, batches, "cached+compact")
 
+    def per_window(node, path):
+        w = node.metrics.val(f"pipeline.readback.windows.{path}")
+        return (node.metrics.val(f"pipeline.readback.bytes.{path}") / w) \
+            if w else None
+
+    rb_compact = per_window(fast, "compact")
+    rb_dense = per_window(dense, "dense")
     snap = fast.pipeline_telemetry.snapshot()
     cache_stats = fast.device_engine.stats()["match_cache"]
     out = {
@@ -170,6 +186,17 @@ def run_skew() -> dict:
         "cached_per_s": round(cached_ps),
         "uncached_per_s": round(uncached_ps),
         "speedup": round(cached_ps / uncached_ps, 2),
+        # ISSUE 3 acceptance pair: same reuse layers, compact vs dense
+        # readback — bytes-per-window reduction (>= 4x at fan-out <= 8)
+        # with no matches/s regression (compact_vs_dense ~>= 1.0)
+        "cached_dense_per_s": round(dense_ps),
+        "compact_vs_dense": round(cached_ps / dense_ps, 2),
+        "readback_bytes_per_window_compact": round(rb_compact)
+        if rb_compact else None,
+        "readback_bytes_per_window_dense": round(rb_dense)
+        if rb_dense else None,
+        "readback_reduction": round(rb_dense / rb_compact, 2)
+        if rb_compact and rb_dense else None,
         "hit_rate": cache_stats["hit_rate"],
         "dedup_ratio": snap.get("dedup", {}).get("ratio"),
         "workload": {
@@ -178,9 +205,9 @@ def run_skew() -> dict:
             "skew": "zipf1.3" if zipf else f"{hot_pct}/{100 - hot_pct}",
         },
         "backend": fast.device_engine.stats()["backend"],
-        # the PR-1 telemetry snapshot: match_cache/dedup counters +
-        # dispatch vs dispatch_cached stage split ride along, so the
-        # speedup is attributable to the exported reuse rate
+        # the PR-1 telemetry snapshot: match_cache/dedup/readback
+        # counters + dispatch vs dispatch_cached stage split ride along,
+        # so the speedup is attributable to the exported reuse rate
         "telemetry": snap,
     }
     return out
