@@ -1326,6 +1326,17 @@ def main():
         skew_bench.main()
         return
 
+    if "--churn" in sys.argv:
+        # sustained-churn microbenchmark for the delta overlay (ISSUE 4
+        # acceptance: overlay >= 2x the rebuild-and-host-fallback
+        # baseline, rebuilds reduced >= 5x, host_delta ~= 0);
+        # full harness lives in tools/churn_bench.py
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import churn_bench
+        churn_bench.main()
+        return
+
     # watchdog: if anything hangs (axon backend init / a stuck transfer),
     # still emit the JSON line before the driver's kill timeout hits
     import signal
@@ -1585,6 +1596,39 @@ def main():
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log(f"skew bench failed: {type(e).__name__}: {e}")
                     result["skew_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if os.environ.get("BENCH_CHURN", "1") != "0":
+                # sustained-churn microbench (ISSUE 4): delta-overlay vs
+                # rebuild-and-host-fallback matches/sec + rebuild counts
+                # + host_delta, CPU subprocess like the skew row
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    sp = subprocess.run(
+                        [sys.executable,
+                         os.path.join(os.path.dirname(
+                             os.path.abspath(__file__)),
+                             "tools", "churn_bench.py")],
+                        capture_output=True, text=True, env=senv,
+                        timeout=int(os.environ.get(
+                            "BENCH_CHURN_TIMEOUT_S", 600)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        # keep the row compact: the rebuild section is
+                        # the interesting telemetry slice here
+                        row.pop("overlay", None)
+                        result["churn"] = row
+                    else:
+                        result["churn_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"churn bench failed: {type(e).__name__}: {e}")
+                    result["churn_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             print(json.dumps(result), flush=True)
             return

@@ -72,6 +72,7 @@ class SysBroker:
         (`pipeline/occupancy/<class>`), plus `pipeline/compiles`,
         `pipeline/decisions` and — when the relevant layer has traffic —
         `pipeline/match_cache` / `pipeline/dedup` / `pipeline/readback`
+        / `pipeline/rebuild`
         (dense-vs-compact device→host transfer bytes, ISSUE 3)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
@@ -87,7 +88,7 @@ class SysBroker:
                   json.dumps(snap["compiles"]).encode())
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
-        for section in ("match_cache", "dedup", "readback"):
+        for section in ("match_cache", "dedup", "readback", "rebuild"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

@@ -295,7 +295,14 @@ class PublishBatcher:
                             # (incremented at materialize) is the
                             # authoritative compact count, this split
                             # stays the routing-decision view
-                            if getattr(handle, "plan", None) is not None:
+                            # device_delta = the dispatch fused the
+                            # churn overlay (ISSUE 4) — takes precedence
+                            # in the split so churn-window throughput is
+                            # attributable to the overlay engaging
+                            if getattr(handle, "delta", None) is not None:
+                                path = "device_delta"
+                            elif getattr(handle, "plan", None) \
+                                    is not None:
                                 path = "device_cached"
                             elif getattr(handle, "pcap", None) \
                                     is not None:

@@ -48,7 +48,11 @@ DEFAULTS: dict = {
         "shared_subscription_strategy": "round_robin",
         "shared_dispatch_ack_enabled": False,
         "route_batch_clean": True,
-        "rebuild_threshold": 256,
+        # None = resolve via EMQX_TPU_REBUILD_THRESHOLD, then the
+        # built-in 256 (device_engine.resolve_rebuild_threshold); an
+        # explicit config value beats both. A baked-in number here
+        # would silently shadow the env knob through the defaults merge.
+        "rebuild_threshold": None,
         "device_min_batch": 4,
         "perf": {"trie_compaction": True},
     },

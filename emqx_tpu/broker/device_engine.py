@@ -24,17 +24,29 @@ immutable arrays"):
   - a filter whose subscriber membership changed since the build is DIRTY —
     its fan-out segment on device is stale, so its deliveries come from the
     live host dict instead (correct for adds, removes and opts changes);
-  - a filter added since the build lives in a DELTA host trie and is matched
-    and dispatched host-side;
+  - a filter added since the build lands in the DEVICE-RESIDENT DELTA
+    OVERLAY (ISSUE 4, ops/delta.py): a small linear-matcher table fused
+    into the route programs, so it is matched AND delivered on device in
+    the same dispatch. The host delta trie remains the fallback for
+    filters the overlay cannot hold (overlay program class still
+    warming, row overflow past the top class, deeper than max_levels) —
+    those match host-side as before, counted by
+    `routing.device.host_delta`. With `EMQX_TPU_DELTA_OVERLAY=0` /
+    `broker.delta_overlay=false` EVERY delta filter takes that host
+    path (the pre-overlay behavior, the A/B baseline);
   - a (filter, group) shared slot that changed is dirty likewise; a group
     added to a built filter is dispatched host-side until the next rebuild.
-- When accumulated churn crosses `rebuild_threshold` the snapshot is
-  recompiled **in the background, double-buffered** (round-2 weak #7): the
-  router/broker state is captured in cooperative chunks on the loop,
-  compiled + uploaded + warm-jitted off the loop, and swapped in atomically
-  once no dispatched batch is outstanding. Mutations during the build are
-  journaled and replayed against the new snapshot at swap, so no churn is
-  lost and serving never stalls on a rebuild.
+- The full rebuild is demoted to a rare **compaction** (overlay row
+  overflow / delete-tombstone ratio / built-filter membership churn past
+  `rebuild_threshold` — see _compaction_reason), recompiled **in the
+  background, double-buffered** (round-2 weak #7): the router/broker
+  state is captured in cooperative chunks on the loop — incrementally,
+  from the previous build's capture plus the touched-filter journal,
+  instead of re-walking the world — compiled + uploaded + warm-jitted
+  off the loop, and swapped in atomically once no dispatched batch is
+  outstanding. Mutations during the build are journaled and replayed
+  against the new snapshot at swap, so no churn is lost and serving
+  never stalls on a rebuild.
 
 Delivery attribution: device fan-out rows for one message are the
 concatenation of per-filter CSR segments in match order, so the host walks
@@ -95,8 +107,54 @@ _ENV_CACHE = os.environ.get("EMQX_TPU_MATCH_CACHE")
 #   criteria compare; config key broker.compact_readback beats the env)
 _ENV_COMPACT = os.environ.get("EMQX_TPU_COMPACT_READBACK", "1") \
     not in ("0", "false", "off")
+#   EMQX_TPU_DELTA_OVERLAY=0 disables the device-resident delta overlay
+#   (ISSUE 4): post-snapshot filters fall back to the pre-overlay
+#   behavior — host-trie match + host dispatch until the next full
+#   rebuild, with full O(N) recaptures at the rebuild threshold (the
+#   A/B knob the churn acceptance criteria compare; config key
+#   broker.delta_overlay beats the env)
+_ENV_DELTA = os.environ.get("EMQX_TPU_DELTA_OVERLAY", "1") \
+    not in ("0", "false", "off")
+
+
+def resolve_rebuild_threshold(configured=None) -> int:
+    """The one rebuild-threshold resolution: config beats
+    EMQX_TPU_REBUILD_THRESHOLD beats the built-in 256. The env knob lets
+    deployments tune churn tolerance without a config edit (mirroring
+    the EMQX_TPU_* family above); it must be a positive integer —
+    anything else is a deployment error worth failing loudly on."""
+    if configured is not None:
+        return int(configured)
+    env = os.environ.get("EMQX_TPU_REBUILD_THRESHOLD")
+    if env is None:
+        return 256
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(
+            f"EMQX_TPU_REBUILD_THRESHOLD={env!r} is not an integer")
+    if val <= 0:
+        raise ValueError(
+            f"EMQX_TPU_REBUILD_THRESHOLD must be > 0, got {val}")
+    return val
+
 
 _snapshot_ids = itertools.count(1)
+
+# delta-overlay capacity ladder (ISSUE 4): pow2 row classes so the jit
+# signature of the fused delta programs stays stable while the overlay
+# grows; beyond the top class the oldest _OVERLAY_MAX delta filters
+# keep their device rows and the rest serve host-side until the
+# compaction the overflow triggers completes. Fan-out is a fixed
+# per-row budget (sub rows = rows * _DELTA_FAN_PER_ROW) so membership
+# growth inside a class never retraces; a delta filter with more
+# subscribers (or rich subopts) keeps its MATCH on device and delivers
+# through the host dict instead.
+_DELTA_CLASSES = (16, 128, 512)
+_OVERLAY_MAX = _DELTA_CLASSES[-1]
+_DELTA_FAN_PER_ROW = 8
+_DELTA_MATCH_CAP = 16
+_DELTA_FANOUT_CAP = 64
 
 
 def _topic_keys(enc: np.ndarray, lens: np.ndarray,
@@ -136,7 +194,7 @@ class _CachePlan:
 
     __slots__ = ("miss_topics", "miss_lens", "miss_dollar", "base_m",
                  "base_c", "base_o", "miss_pos", "inv", "Bm", "n_miss",
-                 "n_hit")
+                 "n_hit", "base_dm", "base_dc", "base_do")
 
     def __init__(self, miss_topics, miss_lens, miss_dollar, base_m,
                  base_c, base_o, miss_pos, inv, Bm, n_miss, n_hit):
@@ -151,17 +209,28 @@ class _CachePlan:
         self.Bm = Bm
         self.n_miss = n_miss
         self.n_hit = n_hit
+        # delta-overlay base rows (overlay ROW-index space; filled only
+        # when the window fuses the overlay — ISSUE 4)
+        self.base_dm = None
+        self.base_dc = None
+        self.base_do = None
 
 
 class _CacheInfo:
     """Post-materialize cache population: (key, flat lane) per unique
-    topic the cache did not have, pinned to the dispatching snapshot."""
+    topic the cache did not have, pinned to the dispatching snapshot.
+    `version` pins the match-cache's delta version at plan time: an
+    overlay insert/delete while this window was in flight makes its
+    readback rows stale (they predate the filter change), so put_many
+    drops the batch on a version mismatch — the delta-aware analog of
+    the snapshot-id check."""
 
-    __slots__ = ("sid", "inserts")
+    __slots__ = ("sid", "inserts", "version")
 
-    def __init__(self, sid, inserts):
+    def __init__(self, sid, inserts, version=None):
         self.sid = sid
         self.inserts = inserts
+        self.version = version
 
 
 class _CsrRes:
@@ -178,6 +247,59 @@ class _CsrRes:
         self.pay = pay            # [W, P] flat payload
         self.overflow = overflow  # [W, B] host-fallback lanes
         self.occur = occur        # [W, G] cursor writeback input
+
+
+class _Overlay:
+    """One immutable VERSION of the delta overlay (ISSUE 4): the device
+    DeltaTables plus the host-side index consume/plan need. Handles pin
+    the version they dispatched against, so an overlay refresh mid-batch
+    can neither re-index an in-flight decode nor swap the arrays under a
+    dispatch — the same pinning discipline as `_Built`."""
+
+    __slots__ = ("dev", "fid_set", "row_of", "seg_of", "hostfan",
+                 "version", "cap", "n")
+
+    def __init__(self, dev, fid_set, row_of, seg_of, hostfan, version,
+                 cap, n):
+        self.dev = dev            # device DeltaTables (row class `cap`)
+        self.fid_set = fid_set    # frozenset of delta fids in the table
+        self.row_of = row_of      # fid -> overlay row index
+        self.seg_of = seg_of      # fid -> device fan-row segment length
+        self.hostfan = hostfan    # fids delivering host-side (rich/big)
+        self.version = version    # overlay clock stamp at build
+        self.cap = cap            # row class (jit signature component)
+        self.n = n                # live rows
+
+
+class _DeltaRes:
+    """Dense host views of one window's delta-overlay planes."""
+
+    __slots__ = ("fids", "counts", "moverflow", "rows", "opts",
+                 "overflow")
+
+    def __init__(self, fids, counts, moverflow, rows, opts, overflow):
+        self.fids = fids          # [W, B, Dm] delta fids
+        self.counts = counts      # [W, B]
+        self.moverflow = moverflow  # [W, B] match-capacity overflow
+        self.rows = rows          # [W, B, Dc]
+        self.opts = opts          # [W, B, Dc]
+        self.overflow = overflow  # [W, B] combined (match | fan-out)
+
+
+class _DeltaCsr:
+    """CSR host views of one window's delta planes (same payload layout
+    as the main CSR with an empty shared family — csr_slices decodes
+    both), plus the always-small dense count/overflow planes."""
+
+    __slots__ = ("off", "c3", "pay", "counts", "moverflow", "overflow")
+
+    def __init__(self, off, c3, pay, counts, moverflow, overflow):
+        self.off = off
+        self.c3 = c3
+        self.pay = pay
+        self.counts = counts
+        self.moverflow = moverflow
+        self.overflow = overflow
 
 
 def _pack_opts(opts: dict) -> int:
@@ -331,7 +453,7 @@ class _Handle:
 
     __slots__ = ("subs", "built", "dev_shared", "enc", "res", "np_res",
                  "np_counts", "error", "refs", "t0", "plan", "cache_info",
-                 "pcap", "cres")
+                 "pcap", "cres", "delta", "dres", "dcres", "np_delta")
 
     def __init__(self, subs, built, dev_shared):
         self.subs = subs          # list of (msgs, words_list, too_long)
@@ -347,20 +469,26 @@ class _Handle:
         self.cache_info = None  # _CacheInfo: rows to insert post-readback
         self.pcap = None      # payload class: CSR-compact this dispatch
         self.cres = None      # device CompactPlanes (set by dispatch)
+        self.delta = None     # _Overlay this dispatch fused (ISSUE 4)
+        self.dres = None      # device DeltaPlanes (set by dispatch)
+        self.dcres = None     # device delta CompactPlanes
+        self.np_delta = None  # host views: _DeltaRes or _DeltaCsr
 
 
 class DeviceRouteEngine:
-    def __init__(self, node, *, rebuild_threshold: int = 256,
+    def __init__(self, node, *, rebuild_threshold: Optional[int] = None,
                  max_levels: int = 16, frontier_cap: int = 16,
                  match_cap: int = 64, fanout_cap: int = 128,
                  slot_cap: int = 16, shape_cap: int = 32,
                  match_cache_size: Optional[int] = None,
                  dedup: Optional[bool] = None,
-                 compact_readback: Optional[bool] = None):
+                 compact_readback: Optional[bool] = None,
+                 delta_overlay: Optional[bool] = None):
         self.node = node
         self.broker = node.broker
         self.router = node.broker.router
-        self.rebuild_threshold = rebuild_threshold
+        self.rebuild_threshold = resolve_rebuild_threshold(
+            rebuild_threshold)
         self.max_levels = max_levels
         self.frontier_cap = frontier_cap
         self.match_cap = match_cap
@@ -429,82 +557,291 @@ class DeviceRouteEngine:
             compact_readback = _ENV_COMPACT
         self.compact_readback = bool(compact_readback)
         self._pay_ewma: dict[int, float] = {}   # Bp -> peak entry total
-        # compact (W, Bp[, Bm], P) classes the serving path asked for,
-        # warmed by the same background thread as the cached ladder
+        # compact (W, Bp[, Bm], P[, Cd]) classes the serving path asked
+        # for, warmed by the same background thread as the cached ladder
         self._wanted_compact: set = set()
+
+        # delta overlay (ISSUE 4 tentpole): post-snapshot filters match
+        # ON DEVICE via a small linear overlay table fused into the
+        # route programs, instead of host-routing until the next full
+        # rebuild. Config beats env beats default-on.
+        if delta_overlay is None:
+            delta_overlay = _ENV_DELTA
+        self.delta_overlay = bool(delta_overlay)
+        self._overlay: Optional[_Overlay] = None  # current serving table
+        self._overlay_stale = False     # journal entries pending apply
+        self._overlay_clock = 0         # monotonic overlay mutation clock
+        self._overlay_uncovered = 0     # live delta filters NOT in the
+                                        # overlay (too deep / past cap)
+        # fid -> clock of its last MEMBERSHIP change: an overlay version
+        # older than the entry has stale fan rows for that fid, so
+        # consume delivers it host-side (the overlay's dirty_filters)
+        self._fid_member_clock: dict[int, int] = {}
+        self._wanted_delta: set = set()  # (W, Bp, Cd) plain delta classes
+        # journal-driven incremental capture (ISSUE 4): the previous
+        # build's capture + the set of filters touched since it — a
+        # compaction refreshes only the touched filters instead of
+        # re-walking the world (see _capture_state_incremental)
+        self._last_capture = None
+        self._touched: set[str] = set()
+        self._built_deleted: set[str] = set()  # snapshot tombstones
+        self._enc_cache: dict[str, list] = {}  # filter -> interned words
 
         # wire change notifications
         self.router.on_route_change = self.note_route_change
         self.broker.device_engine = self
+        tele = getattr(node, "pipeline_telemetry", None)
+        if tele is not None:
+            tele.rebuild_state_fn = self.rebuild_state
 
     # ---- churn tracking -------------------------------------------------
     def staleness(self) -> int:
         """Distinct stale entities vs the snapshot (filters/slots serving
         host-side) — the rebuild trigger. A set-size measure, so repeated
         churn on one filter counts once and the subscribe path's double
-        notification (route change + member change) cannot double-count."""
-        return (len(self.dirty_filters) + len(self.dirty_slots)
-                + len(self._delta_filter)
+        notification (route change + member change) cannot double-count.
+        With the delta overlay on (ISSUE 4), post-snapshot filters are
+        matched AND delivered on device, so they no longer count toward
+        the full-rebuild trigger — overlay overflow and the snapshot
+        tombstone ratio trigger compactions instead
+        (_compaction_reason). DELETED built filters likewise move to
+        the tombstone-ratio trigger: a tombstone costs a slow-path
+        consume only for messages that still match it (it delivers
+        nothing), so under rolling subscribe/unsubscribe churn it must
+        not drip the churn counter over the threshold — that would
+        recreate exactly the rebuild cadence the overlay exists to
+        demote."""
+        base = (len(self.dirty_filters) + len(self.dirty_slots)
                 + sum(len(v) for v in self.new_slots_by_filter.values()))
+        if self.delta_overlay:
+            base -= len(self._built_deleted)    # ⊆ dirty_filters
+            # delta filters the overlay CANNOT hold (deeper than
+            # max_levels, or past the top row class) serve host-side
+            # and disable the fast consume — they must keep counting
+            # toward the rebuild trigger exactly like the overlay-off
+            # path, or one deep filter would degrade every message's
+            # consume forever with nothing ever healing it
+            base += self._overlay_uncovered
+        else:
+            base += len(self._delta_filter)
+        return base
+
+    def journal_depth(self) -> int:
+        """Filters touched since the last capture — the incremental
+        compaction's pending work (exported via the rebuild telemetry
+        section)."""
+        return len(self._touched)
+
+    def _enc_filter(self, f: str) -> list:
+        """Interned level ids of a filter, memoized across builds: word
+        ids are append-only for the process lifetime (ops/intern.py), so
+        the encoding never goes stale and the compaction path reuses the
+        previous build's work instead of re-tokenizing the universe."""
+        w = self._enc_cache.get(f)
+        if w is None:
+            w = self._enc_cache[f] = self.intern.encode_filter(
+                T.tokens(f))
+        return w
+
+    def _overlay_changed(self, words, deleted_fid=None) -> None:
+        """Bookkeeping shared by delta insert and delete: bump the
+        overlay clock, mark the table stale, and make the match cache
+        delta-aware — drop exactly the cached topics the changed filter
+        matches (host-side check over the stored encoded topics) plus
+        bump the cache's delta version so in-flight readbacks that
+        predate this change cannot insert stale rows."""
+        self._overlay_clock += 1
+        self._overlay_stale = True
+        if deleted_fid is not None:
+            self._fid_member_clock.pop(deleted_fid, None)
+        cache = self._match_cache
+        if cache is not None:
+            from emqx_tpu.ops.delta import np_filter_match
+            cache.bump_delta_version()
+            if len(cache):
+                cache.drop_where(
+                    self._built.sid if self._built else None,
+                    lambda encs, lens, dols: np_filter_match(
+                        words, encs, lens, dols))
 
     def note_route_change(self, topic_filter: str, added: bool) -> None:
         """Router filter-universe change (local subscribe path and
         cluster-replicated remote routes both land here)."""
         if self._journal is not None:
             self._journal.append(("route", topic_filter, added))
+        self._touched.add(topic_filter)
+        removed_words = None
+        if not added:
+            # read the memo BEFORE evicting it: the delete path below
+            # needs the encoding and must not re-tokenize per delete
+            # under rolling unsubscribe churn
+            removed_words = self._enc_cache.pop(topic_filter, None)
         if self._built is None:
             return
         if added:
             if topic_filter in self._built.fid_of:
                 self.dirty_filters.add(topic_filter)
+                self._built_deleted.discard(topic_filter)
             elif topic_filter not in self._delta_fid_of:
-                words = self.intern.encode_filter(T.tokens(topic_filter))
+                words = self._enc_filter(topic_filter)
                 fid = self._next_delta_fid
                 self._next_delta_fid += 1
                 self._delta_trie.insert(words, fid)
                 self._delta_filter[fid] = topic_filter
                 self._delta_fid_of[topic_filter] = fid
+                if self.delta_overlay:
+                    self._overlay_changed(words)
         else:
             if topic_filter in self._built.fid_of:
                 self.dirty_filters.add(topic_filter)
+                self._built_deleted.add(topic_filter)
             fid = self._delta_fid_of.pop(topic_filter, None)
             if fid is not None:
-                words = self.intern.encode_filter(T.tokens(topic_filter))
+                words = removed_words if removed_words is not None \
+                    else self.intern.encode_filter(T.tokens(topic_filter))
                 self._delta_trie.delete(words)
                 self._delta_filter.pop(fid, None)
+                if self.delta_overlay:
+                    self._overlay_changed(words, deleted_fid=fid)
 
     def note_member_change(self, real: str, group: Optional[str]) -> None:
         """Broker membership change (subscribe/unsubscribe/opts update)."""
         if self._journal is not None:
             self._journal.append(("member", real, group))
+        self._touched.add(real)
         self._cluster_groups_cache.pop(real, None)
         if self._built is None:
             return
         if group is None:
             if real in self._built.fid_of:
                 self.dirty_filters.add(real)
+            elif self.delta_overlay:
+                fid = self._delta_fid_of.get(real)
+                if fid is not None:
+                    # overlay fan rows for this fid are stale: versions
+                    # at/below this clock deliver it host-side until the
+                    # next overlay apply refreshes the row (match rows
+                    # are membership-independent — no cache action)
+                    self._overlay_clock += 1
+                    self._fid_member_clock[fid] = self._overlay_clock
+                    self._overlay_stale = True
         else:
             if (real, group) in self._built.slot_of:
                 self.dirty_slots.add((real, group))
             elif real in self._built.fid_of:
                 self.new_slots_by_filter.setdefault(real, set()).add(group)
-            # delta filters dispatch host-side entirely — nothing to track
+            # delta filters' shared groups dispatch host-side via the
+            # consume sweep over live broker.shared — nothing to track
 
     # ---- snapshot compile ----------------------------------------------
+    def _observe_rebuild(self, stage: str, t0: float) -> None:
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        if tele is not None:
+            tele.observe_rebuild(stage, time.perf_counter() - t0)
+
     def rebuild(self) -> None:
         """Compile router+broker state into fresh device tables and swap,
         synchronously (first build / callers without a loop). The background
-        path is `maybe_background_rebuild`."""
-        capture = self._capture_state_sync()
+        path is `maybe_background_rebuild`. Reuses the previous build's
+        capture + the touched-filter journal when the overlay machinery
+        is on (the incremental-compaction path — see
+        _capture_state_incremental)."""
+        t0 = time.perf_counter()
+        if self._can_capture_incremental():
+            capture = self._capture_state_incremental()
+        else:
+            capture = self._capture_state_sync()
+        self._observe_rebuild("capture", t0)
+        t0 = time.perf_counter()
         result = self._build_from_capture(capture)
+        self._observe_rebuild("build", t0)
+        t0 = time.perf_counter()
         self._apply_build(result, journal=())
+        self._observe_rebuild("swap", t0)
 
     def _capture_shared(self, f: str) -> dict:
         return capture_shared(self.broker, f)
 
+    def _note_captured(self, capture) -> None:
+        """A capture just completed: it becomes the incremental
+        baseline. Called from every capture path BEFORE mutations racing
+        the build can land (those re-enter _touched via note_*)."""
+        if self.delta_overlay:
+            self._last_capture = capture
+
+    def _can_capture_incremental(self) -> bool:
+        return self.delta_overlay and self._last_capture is not None
+
+    def _incremental_refresh_set(self) -> set:
+        """Filters the incremental capture must re-walk: everything
+        touched since the last capture, plus every shared-group filter
+        (old and new) — shared captures carry CURSOR state that advances
+        on every dispatch without a note_* notification, so reusing a
+        stale shared capture would reset round-robin rotation at each
+        compaction. Shared filters are a small slice of the universe, so
+        this keeps the capture o(touched + shared), never O(N)."""
+        refresh = set(self._touched)
+        self._touched = set()   # re-touches during the capture re-add
+        _e, _w, _subs, shared0 = self._last_capture
+        refresh |= set(shared0)
+        refresh |= set(self.broker.shared)
+        return refresh
+
+    def _apply_refresh(self, subs: dict, shared: dict, fs) -> None:
+        """Refresh one chunk of filters from live state into the capture
+        dicts (shared by the sync and async incremental captures)."""
+        broker = self.broker
+        for f in fs:
+            s = broker.subs.get(f)
+            if s:
+                subs[f] = list(s.items())
+            else:
+                subs.pop(f, None)
+            cap = self._capture_shared(f)
+            if cap:
+                shared[f] = cap
+            else:
+                shared.pop(f, None)
+
+    def _capture_state_incremental(self):
+        """Journal-driven capture (ISSUE 4): start from the previous
+        build's capture and re-walk ONLY the filters touched since (plus
+        the shared set — see _incremental_refresh_set), instead of the
+        full O(N) state walk. The filter universe lists are re-snapshotted
+        live (two atomic C calls); _build_from_capture keys everything
+        else off them, so filters added/removed since the baseline are
+        picked up/dropped by construction."""
+        router = self.router
+        exact, wild = list(router.exact), list(router.wildcards)
+        _e, _w, subs0, shared0 = self._last_capture
+        subs, shared = dict(subs0), dict(shared0)
+        self._apply_refresh(subs, shared, self._incremental_refresh_set())
+        capture = (exact, wild, subs, shared)
+        self._note_captured(capture)
+        return capture
+
+    async def _capture_state_incremental_async(self, chunk: int = 1024):
+        """Chunked incremental capture (the background-compaction
+        flavor): same refresh set, yielding between chunks; mutations
+        landing mid-capture re-enter _touched AND the build journal, so
+        they converge at swap exactly like the full capture's races."""
+        import asyncio
+        router = self.router
+        exact, wild = list(router.exact), list(router.wildcards)
+        _e, _w, subs0, shared0 = self._last_capture
+        subs, shared = dict(subs0), dict(shared0)
+        refresh = sorted(self._incremental_refresh_set())
+        for i in range(0, len(refresh), chunk):
+            self._apply_refresh(subs, shared, refresh[i:i + chunk])
+            await asyncio.sleep(0)
+        capture = (exact, wild, subs, shared)
+        self._note_captured(capture)
+        return capture
+
     def _capture_state_sync(self):
         """Point-in-time copy of the routing state (sync, may stall)."""
         broker, router = self.broker, self.router
+        self._touched = set()
         exact, wild = list(router.exact), list(router.wildcards)
         filters = exact + wild
         subs = {f: list(broker.subs[f].items())
@@ -514,7 +851,9 @@ class DeviceRouteEngine:
             cap = self._capture_shared(f)
             if cap:
                 shared[f] = cap
-        return exact, wild, subs, shared
+        capture = (exact, wild, subs, shared)
+        self._note_captured(capture)
+        return capture
 
     async def _capture_state_async(self, chunk: int = 1024):
         """Chunked capture: yields to the loop between chunks so serving
@@ -525,6 +864,7 @@ class DeviceRouteEngine:
         """
         import asyncio
         broker, router = self.broker, self.router
+        self._touched = set()
         exact, wild = list(router.exact), list(router.wildcards)
         filters = exact + wild
         subs: dict = {}
@@ -538,7 +878,9 @@ class DeviceRouteEngine:
                 if cap:
                     shared[f] = cap
             await asyncio.sleep(0)
-        return exact, wild, subs, shared
+        capture = (exact, wild, subs, shared)
+        self._note_captured(capture)
+        return capture
 
     def _build_from_capture(self, capture):
         """Compile a captured state into device tables (loop-free: safe on
@@ -561,7 +903,9 @@ class DeviceRouteEngine:
         b.fid_of = {f: i for i, f in enumerate(filters)}
         b.fid_filter = filters
         n = len(filters)
-        words = [self.intern.encode_filter(T.tokens(f)) for f in filters]
+        # memoized encodings (ISSUE 4): a compaction re-encodes only
+        # filters it has never seen, not the universe
+        words = [self._enc_filter(f) for f in filters]
         L = max(1, max(len(w) for w in words))
         rows = np.zeros((n, L), np.int32)
         lens = np.zeros(n, np.int64)
@@ -677,14 +1021,19 @@ class DeviceRouteEngine:
             # swap for the rest of the process lifetime
             self._wanted_cached.clear()
             self._wanted_compact.clear()
-        # match-cache invalidation: wholesale, HERE, and nowhere else.
-        # Invariant: within one snapshot's lifetime the device tables are
-        # immutable — subscription churn marks filters/slots dirty and
-        # those deliver host-side against the PINNED snapshot (the
-        # dirty/delta scheme above), so a cached match row can never go
-        # stale between swaps; per-snapshot keying is sufficient for
-        # correctness. The id check inside the cache then makes serving
-        # rows across snapshot ids structurally impossible.
+            self._wanted_delta.clear()
+        # match-cache invalidation: wholesale, HERE — and, with the
+        # delta overlay on, at overlay inserts/deletes where ONLY the
+        # cached topics matching the changed filter drop
+        # (_overlay_changed; ISSUE 4's delta-aware invalidation).
+        # Invariant: within one snapshot's lifetime the MAIN device
+        # tables are immutable — subscription churn marks filters/slots
+        # dirty and those deliver host-side against the PINNED snapshot
+        # (the dirty/delta scheme above), so a cached MAIN row can never
+        # go stale between swaps; the cached DELTA rows are kept exact
+        # by the selective drop + the put-side delta-version check. The
+        # id check inside the cache then makes serving rows across
+        # snapshot ids structurally impossible.
         if self._match_cache is not None:
             self._match_cache.attach(
                 self._built.sid if self._built is not None else None)
@@ -709,13 +1058,52 @@ class DeviceRouteEngine:
         self._delta_filter = {}
         self._delta_fid_of = {}
         self._next_delta_fid = 0
+        self._built_deleted = set()
+        # the fresh snapshot subsumes every overlay row: reset the
+        # overlay (version monotonicity rides the clock, which is NOT
+        # reset — in-flight handles pinned to an old overlay keep their
+        # consistent view)
+        self._overlay = None
+        self._overlay_stale = False
+        self._overlay_uncovered = 0
+        self._fid_member_clock = {}
+
+    def _compaction_reason(self) -> Optional[str]:
+        """Why the current snapshot should recompile, or None.
+
+        Overlay off: the pre-ISSUE-4 policy — distinct stale entities
+        (incl. every delta filter) past the threshold. Overlay on: delta
+        filters serve on device, so the full rebuild is demoted to a
+        rare COMPACTION triggered by (a) overlay row overflow, (b) the
+        snapshot's delete-tombstone ratio — deleted built filters still
+        burn match work and dirty-set checks every batch, or (c)
+        membership churn on built filters/slots (still host-side) past
+        the threshold."""
+        if self._built is None:
+            return None
+        if not self.delta_overlay:
+            return "churn" if self.staleness() >= self.rebuild_threshold \
+                else None
+        if len(self._delta_filter) > _OVERLAY_MAX:
+            return "overflow"
+        dead = len(self._built_deleted)
+        if dead >= 64 and 2 * dead >= len(self._built.fid_filter):
+            return "tombstones"
+        if self.staleness() >= self.rebuild_threshold:
+            return "churn"
+        return None
+
+    def _count_compaction(self, reason: str) -> None:
+        m = self.node.metrics
+        m.inc("routing.device.compactions")
+        m.inc(f"routing.device.compaction.{reason}")
 
     # ---- background rebuild (double-buffered, round-2 weak #7) ----------
     def poll_rebuild(self) -> None:
         """The one rebuild policy, called on the batch cadence: a small
         first build runs inline (milliseconds — the first batch already
-        rides the device); a big first build or a threshold crossing runs
-        double-buffered in the background."""
+        rides the device); a big first build or a compaction trigger
+        (_compaction_reason) runs double-buffered in the background."""
         if self._building:
             return
         if self._built is None:
@@ -724,18 +1112,20 @@ class DeviceRouteEngine:
                 return
             if n <= 4096 or not self.maybe_background_rebuild():
                 self.rebuild()
-        elif self.staleness() >= self.rebuild_threshold:
-            self.maybe_background_rebuild()
+        else:
+            reason = self._compaction_reason()
+            if reason is not None and self.maybe_background_rebuild():
+                self._count_compaction(reason)
 
     def maybe_background_rebuild(self, executor=None) -> bool:
-        """Kick a background rebuild when churn crossed the threshold.
-        Returns True when one is running/queued after the call. Requires a
-        running loop; sync callers use rebuild()."""
+        """Kick a background rebuild when churn crossed a compaction
+        trigger. Returns True when one is running/queued after the call.
+        Requires a running loop; sync callers use rebuild()."""
         import asyncio
         if self._building:
             return True
         if self._built is not None \
-                and self.staleness() < self.rebuild_threshold:
+                and self._compaction_reason() is None:
             return False
         if self._built is None \
                 and not (self.router.exact or self.router.wildcards):
@@ -754,12 +1144,21 @@ class DeviceRouteEngine:
         import asyncio
         loop = asyncio.get_running_loop()
         try:
-            capture = await self._capture_state_async()
+            t0 = time.perf_counter()
+            if self._can_capture_incremental():
+                capture = await self._capture_state_incremental_async()
+            else:
+                capture = await self._capture_state_async()
+            self._observe_rebuild("capture", t0)
+            t0 = time.perf_counter()
             result = await loop.run_in_executor(
                 executor, self._build_from_capture, capture)
+            self._observe_rebuild("build", t0)
             if result is not None:
+                t0 = time.perf_counter()
                 await loop.run_in_executor(executor, self._warm_compile,
                                            result)
+                self._observe_rebuild("warm", t0)
             self._pending_swap = (result,)   # 1-tuple: result may be None
             self._try_swap()
         except Exception:
@@ -832,7 +1231,9 @@ class DeviceRouteEngine:
         self._pending_swap = None
         self._journal = None
         self._building = False
+        t0 = time.perf_counter()
         self._apply_build(result, journal)
+        self._observe_rebuild("swap", t0)
 
     # ---- the serving path ----------------------------------------------
     def device_shared_active(self) -> bool:
@@ -855,11 +1256,124 @@ class DeviceRouteEngine:
         return bool(g and g.members
                     and broker._shared_pick_deliver(gname, f, g, msg))
 
+    # ---- delta overlay (ISSUE 4) ----------------------------------------
+    def _overlay_class(self, n: int) -> int:
+        for c in _DELTA_CLASSES:
+            if n <= c:
+                return c
+        return _DELTA_CLASSES[-1]
+
+    @staticmethod
+    def _delta_payload_cap(Bp: int) -> int:
+        """Delta CSR payload class, a fixed multiple of Bp (so it adds
+        no warm-class dimension): overlay matches are sparse — most
+        lanes match zero post-snapshot filters — so one entry per lane
+        of headroom covers realistic churn; a window that still outgrows
+        it reads the dense delta planes of the same dispatch."""
+        return max(64, Bp)
+
+    def _overlay_sync(self) -> None:
+        """Apply pending journal entries to the overlay: rebuild the
+        small host table from the live delta dicts and upload a fresh
+        DeltaTables version. The table is a few hundred rows of numpy —
+        microseconds, safe on the loop; the EXPENSIVE part (the fused
+        program compile for a new row class) is demand-warmed off the
+        serving path like the cached/compact ladders (_gate_delta).
+        Versions are immutable: in-flight handles keep the table they
+        dispatched with, and per-fid membership staleness is judged
+        against the pinned version's clock stamp at consume."""
+        if not self.delta_overlay or not self._overlay_stale:
+            return
+        t0 = time.perf_counter()
+        from emqx_tpu.ops.delta import build_delta_tables
+        live = sorted(self._delta_filter.items())   # fid order = age
+        entries = []
+        fid_set = set()
+        row_of: dict[int, int] = {}
+        seg_of: dict[int, int] = {}
+        hostfan: set[int] = set()
+        for fid, f in live:
+            if len(entries) >= _OVERLAY_MAX:
+                break       # overflow: the rest host-route until the
+                            # compaction this state has already triggered
+            words = self._enc_filter(f)
+            if len(words) > self.max_levels:
+                continue    # too deep for the device planes: host path
+            fan = []
+            subs = self.broker.subs.get(f)
+            host_side = False
+            if subs:
+                if len(subs) > _DELTA_FAN_PER_ROW:
+                    host_side = True    # oversized fan-out: match on
+                else:                   # device, deliver via host dict
+                    for sid, opts in subs.items():
+                        if _is_rich(opts):
+                            host_side = True
+                            break
+                        fan.append((sid, _pack_opts(opts)))
+            if host_side:
+                fan = []
+                hostfan.add(fid)
+            row_of[fid] = len(entries)
+            seg_of[fid] = len(fan)
+            fid_set.add(fid)
+            entries.append((words, fid, fan))
+        self._overlay_uncovered = len(live) - len(fid_set)
+        if not entries:
+            self._overlay = None
+            self._overlay_stale = False
+            return
+        cap = self._overlay_class(len(entries))
+        dt = build_delta_tables(entries, row_cap=cap,
+                                level_cap=self.max_levels,
+                                fan_per_row=_DELTA_FAN_PER_ROW)
+        import jax
+        dev = jax.device_put(dt)
+        self._overlay = _Overlay(dev, frozenset(fid_set), row_of, seg_of,
+                                 hostfan, self._overlay_clock, cap,
+                                 len(entries))
+        self._overlay_stale = False
+        self.node.metrics.inc("routing.device.delta_applies")
+        self._observe_rebuild("delta_apply", t0)
+
+    def _gate_delta(self, Wp: int, Bp: int,
+                    gate_cold: bool) -> Optional[_Overlay]:
+        """Choose + warm-gate the overlay for one dispatch. Returns the
+        pinned _Overlay, or None to dispatch WITHOUT the fused overlay
+        (overlay off/empty, or its class is cold on the serving path —
+        the pre-overlay host fallback stays correct meanwhile and the
+        routing.device.host_delta counter measures exactly that gap)."""
+        if not self.delta_overlay:
+            return None
+        self._overlay_sync()
+        ov = self._overlay
+        if ov is None:
+            return None
+        key = (self._cur_sig, Wp, Bp, f"d{ov.cap}")
+        if gate_cold and key not in self._warm_classes:
+            self._wanted_delta.add((Wp, Bp, ov.cap))
+            self._kick_class_warm()
+            self.node.metrics.inc("routing.device.cold_delta_class")
+            return None
+        return ov
+
+    def _delta_pending(self, ov: Optional[_Overlay]) -> bool:
+        """True when some live delta filter is NOT served by `ov` (no
+        overlay this dispatch, or filters landed/overflowed past it) —
+        consume must then run the host delta trie for the uncovered
+        remainder and the vectorized fast path stands down."""
+        if not self._delta_filter:
+            return False
+        if ov is None:
+            return True
+        return not self._delta_filter.keys() <= ov.fid_set
+
     def prepare(self, msgs: list[Message], gate_cold: bool = True):
         """Stage 1 (event loop): encode ONE micro-batch (window of 1)."""
         return self.prepare_window([msgs], gate_cold=gate_cold)
 
-    def _plan_window(self, b, enc4, len4, dol4, gate_cold: bool):
+    def _plan_window(self, b, enc4, len4, dol4, gate_cold: bool,
+                     ov: Optional[_Overlay] = None):
         """Dedup + match-cache analysis for one encoded window.
 
         Collapses the [Wp, Bp] lanes to unique encoded topics (padding
@@ -921,6 +1435,18 @@ class DeviceRouteEngine:
                 if k is None:
                     continue
                 row = next(it)
+                if row is not None and ov is not None:
+                    # delta-fused dispatch: a usable hit must carry the
+                    # overlay base triple (rows inserted from a window
+                    # that dispatched without the overlay store None
+                    # there) and its fids must map into the pinned
+                    # table (deleted fids are swept by the delta-aware
+                    # invalidation, so a miss here is a transient race,
+                    # not a leak)
+                    if len(row) < 6 or row[3] is None or not all(
+                            int(df) in ov.row_of for df in row[3]
+                            if df >= 0):
+                        row = None
                 if row is None:
                     miss_u.append(u)
                     inserts.append((k, int(first_idx[u])))
@@ -928,7 +1454,10 @@ class DeviceRouteEngine:
                     hit_rows[u] = row
         else:
             miss_u = [u for u in range(Bu) if keys[u] is not None]
-        info = _CacheInfo(b.sid, inserts) if inserts else None
+        info = _CacheInfo(
+            b.sid, inserts,
+            cache.delta_version if cache is not None
+            and self.delta_overlay else None) if inserts else None
         n_miss = len(miss_u)
         n_hit = uniq_real - n_miss
         Bm = self._batch_class(max(1, n_miss))
@@ -941,25 +1470,43 @@ class DeviceRouteEngine:
         # traces for its class).
         if not (Bm < Bp or Wp > 1):
             return None, info
+        dsuf = (f"d{ov.cap}",) if ov is not None else ()
+        dC = ov.cap if ov is not None else None
         if gate_cold \
-                and (self._cur_sig, Wp, Bp, Bm) not in self._warm_classes:
-            # serving path: a cold cached (W, Bp, Bm) class would stall
-            # on an in-path XLA compile — dispatch the warm plain
+                and (self._cur_sig, Wp, Bp, Bm) + dsuf \
+                not in self._warm_classes:
+            # serving path: a cold cached (W, Bp, Bm[, dC]) class would
+            # stall on an in-path XLA compile — dispatch the warm plain
             # program instead and let the background warm bring the
             # class online (same policy as batch_class_warm; trie
             # classes are keyed under the empty signature)
-            self._wanted_cached.add((Wp, Bp, Bm))
+            self._wanted_cached.add((Wp, Bp, Bm, dC))
             self._kick_class_warm()
             self.node.metrics.inc("routing.device.cold_cached_class")
             return None, info
         base_m = np.full((Bp, b.match_width), -1, np.int32)
         base_c = np.zeros(Bp, np.int32)
         base_o = np.zeros(Bp, bool)
+        base_dm = base_dc = base_do = None
+        if ov is not None:
+            base_dm = np.full((Bp, _DELTA_MATCH_CAP), -1, np.int32)
+            base_dc = np.zeros(Bp, np.int32)
+            base_do = np.zeros(Bp, bool)
         for u, row in enumerate(hit_rows):
             if row is not None:
                 base_m[u] = row[0]
                 base_c[u] = row[1]
                 base_o[u] = row[2]
+                if ov is not None:
+                    # cached delta triples are FID-space (stable across
+                    # overlay row reassignments); map onto the pinned
+                    # table's row indices for the device-side merge
+                    dm = row[3]
+                    for j, df in enumerate(dm):
+                        if df >= 0:
+                            base_dm[u, j] = ov.row_of[int(df)]
+                    base_dc[u] = row[4]
+                    base_do[u] = row[5]
         miss_topics = np.full((Bm, L), I.PAD, np.int32)
         miss_lens = np.zeros(Bm, np.int32)
         miss_dollar = np.zeros(Bm, bool)
@@ -978,6 +1525,8 @@ class DeviceRouteEngine:
                           base_c, base_o, miss_pos,
                           inv.reshape(Wp, Bp).astype(np.int32), Bm,
                           n_miss, n_hit)
+        plan.base_dm, plan.base_dc, plan.base_do = base_dm, base_dc, \
+            base_do
         # telemetry is recorded ONLY for engaged plans, so the exported
         # dedup ratio / hit rate describe match work actually removed
         # from dispatches — not lookups whose window went plain (those
@@ -1095,56 +1644,77 @@ class DeviceRouteEngine:
         self._pay_ewma[Bp] = s if (ew is None or s > ew) \
             else 0.8 * ew + 0.2 * s
 
-    def _gate_compact(self, Wp: int, Bp: int, plan,
-                      gate_cold: bool) -> Optional[int]:
+    def _gate_compact(self, Wp: int, Bp: int, plan, gate_cold: bool,
+                      ov: Optional[_Overlay] = None) -> Optional[int]:
         """Choose + warm-gate the payload class for one dispatch.
         Returns the class, or None to read back dense (compaction off,
         unprofitable, or the class is cold on the serving path)."""
         pcap = self._choose_payload_cap(Bp)
         if pcap is None:
             return None
+        dsuf = (f"d{ov.cap}",) if ov is not None else ()
         key = (self._cur_sig, Wp, Bp) \
-            + ((plan.Bm,) if plan is not None else ()) + (f"c{pcap}",)
+            + ((plan.Bm,) if plan is not None else ()) + dsuf \
+            + (f"c{pcap}",)
         if gate_cold and key not in self._warm_classes:
             # same policy as the cached ladder: a cold compact class
             # would stall serving on an in-path XLA compile — dispatch
             # with the dense readback and let the background warm bring
             # the class online
             self._wanted_compact.add(
-                (Wp, Bp, plan.Bm if plan is not None else None, pcap))
+                (Wp, Bp, plan.Bm if plan is not None else None, pcap,
+                 ov.cap if ov is not None else None))
             self._kick_class_warm()
             self.node.metrics.inc("routing.device.cold_compact_class")
             return None
         return pcap
 
+    @staticmethod
+    def _class_key(sig, Wp, Bp, Bm=None, dC=None, P=None) -> tuple:
+        """The one warm-class key layout: (sig, W, Bp[, Bm][, dN][, cP])
+        — dedup miss class, delta-overlay row class and compact payload
+        class are each optional program dimensions."""
+        return ((sig, Wp, Bp)
+                + ((Bm,) if Bm is not None else ())
+                + ((f"d{dC}",) if dC is not None else ())
+                + ((f"c{P}",) if P is not None else ()))
+
     def _kick_class_warm(self) -> None:
         """Warm every standard (W, Bp) class AND every demand-registered
-        cached-dispatch (W, Bp, Bm) class the CURRENT snapshot is
-        missing, off the serving path. Re-kicks after a failure and
-        after any swap to unwarmed capacity classes. The standard ladder
-        is shapes-only (trie compiles its plain step in-path, as ever),
-        but cached classes warm for BOTH backends — the gate in
-        _plan_window holds every backend's cached dispatch back until
-        its class is warm."""
+        cached / delta-overlay / compact program class the CURRENT
+        snapshot is missing, off the serving path. Re-kicks after a
+        failure and after any swap to unwarmed capacity classes. The
+        standard ladder is shapes-only (trie compiles its plain step
+        in-path, as ever); cached/delta/compact classes warm for BOTH
+        backends — the gates hold each program variant back until its
+        class is warm."""
         import asyncio
         if self._fuse_warm_task is not None or self._built is None:
             return
         backend = self._built.backend
+        ck = self._class_key
         missing = []
         if backend == "shapes":
             wanted = self._STD_CLASSES + tuple(sorted(self._extra_classes))
             missing = [(W, Bp) for W, Bp in wanted
                        if (self._cur_sig, W, Bp) not in self._warm_classes]
+        delta_missing = [
+            e for e in sorted(self._wanted_delta)
+            if ck(self._cur_sig, e[0], e[1], dC=e[2])
+            not in self._warm_classes]
         cached_missing = [
-            (W, Bp, Bm) for W, Bp, Bm in sorted(self._wanted_cached)
-            if (self._cur_sig, W, Bp, Bm) not in self._warm_classes]
+            e for e in sorted(self._wanted_cached,
+                              key=lambda e: (e[0], e[1], e[2], e[3] or 0))
+            if ck(self._cur_sig, e[0], e[1], Bm=e[2], dC=e[3])
+            not in self._warm_classes]
         compact_missing = [
             e for e in sorted(self._wanted_compact,
-                              key=lambda e: (e[0], e[1], e[2] or 0, e[3]))
-            if (self._cur_sig, e[0], e[1])
-            + ((e[2],) if e[2] is not None else ())
-            + (f"c{e[3]}",) not in self._warm_classes]
-        if not missing and not cached_missing and not compact_missing:
+                              key=lambda e: (e[0], e[1], e[2] or 0, e[3],
+                                             e[4] or 0))
+            if ck(self._cur_sig, e[0], e[1], Bm=e[2], dC=e[4], P=e[3])
+            not in self._warm_classes]
+        if not missing and not delta_missing and not cached_missing \
+                and not compact_missing:
             return
         try:
             loop = asyncio.get_running_loop()
@@ -1161,84 +1731,164 @@ class DeviceRouteEngine:
 
             import jax
 
-            from emqx_tpu.models.router_engine import (route_step_cached,
-                                                       route_window_cached,
-                                                       route_window_full)
+            from emqx_tpu.models.router_engine import (
+                route_step_cached, route_step_delta,
+                route_step_delta_cached, route_window_cached,
+                route_window_delta, route_window_delta_cached,
+                route_window_full)
+            from emqx_tpu.ops.delta import empty_delta_tables
             from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
             strat = np.int32(STRATEGY_ROUND_ROBIN)
+
+            def dummy_delta(dC):
+                # shapes are all that matter for the trace; an all-empty
+                # table of the class is the cheapest valid instance
+                return empty_delta_tables(dC, self.max_levels,
+                                          fan_per_row=_DELTA_FAN_PER_ROW)
+
+            def ctx_of(label):
+                return tele.compile_context(label) if tele is not None \
+                    else contextlib.nullcontext()
+
             for Wp, Bp in missing:
-                ctx = tele.compile_context(f"warm W{Wp}xB{Bp}") \
-                    if tele is not None else contextlib.nullcontext()
                 enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
                 z = np.zeros((Wp, Bp), np.int32)
-                with ctx:
+                with ctx_of(f"warm W{Wp}xB{Bp}"):
                     r = route_window_full(
                         tables, cursors, enc, z, np.zeros((Wp, Bp), bool),
                         z, strat, fanout_cap=self.fanout_cap,
                         slot_cap=self.slot_cap)
                     jax.block_until_ready(r.match_counts)
                 self._warm_classes.add((sig, Wp, Bp))
+            # demand-driven delta-overlay classes (ISSUE 4): each
+            # (W, Bp, dC) is one fused program; the serving path keeps
+            # the host delta fallback until its class lands here
+            for Wp, Bp, dC in delta_missing:
+                dt = dummy_delta(dC)
+                enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
+                z = np.zeros((Wp, Bp), np.int32)
+                zb = np.zeros((Wp, Bp), bool)
+                with ctx_of(f"warm W{Wp}xB{Bp}d{dC}"):
+                    if backend == "shapes":
+                        r = route_window_delta(
+                            tables, dt, cursors, enc, z, zb, z, strat,
+                            fanout_cap=self.fanout_cap,
+                            slot_cap=self.slot_cap,
+                            delta_match_cap=_DELTA_MATCH_CAP,
+                            delta_fanout_cap=_DELTA_FANOUT_CAP)
+                    else:   # trie delta dispatches are single-batch
+                        r = route_step_delta(
+                            tables, dt, cursors, enc[0], z[0], zb[0],
+                            z[0], strat, frontier_cap=self.frontier_cap,
+                            match_cap=self.match_cap,
+                            fanout_cap=self.fanout_cap,
+                            slot_cap=self.slot_cap,
+                            delta_match_cap=_DELTA_MATCH_CAP,
+                            delta_fanout_cap=_DELTA_FANOUT_CAP)
+                    jax.block_until_ready(r.res.match_counts)
+                self._warm_classes.add(ck(sig, Wp, Bp, dC=dC))
             # demand-driven cached-dispatch classes: the serving path
-            # registered every (W, Bp, Bm) a dedup plan wanted and fell
-            # back to the plain program meanwhile
-            for Wp, Bp, Bm in cached_missing:
-                ctx = tele.compile_context(f"warm W{Wp}xB{Bp}mB{Bm}") \
-                    if tele is not None else contextlib.nullcontext()
+            # registered every (W, Bp, Bm[, dC]) a dedup plan wanted and
+            # fell back to the plain program meanwhile
+            for Wp, Bp, Bm, dC in cached_missing:
                 args = (np.full((Bm, self.max_levels), I.PAD, np.int32),
                         np.zeros(Bm, np.int32), np.zeros(Bm, bool),
                         np.full((Bp, match_width), -1, np.int32),
-                        np.zeros(Bp, np.int32), np.zeros(Bp, bool),
-                        np.full(Bm, Bp, np.int32))   # pad = Bp: dropped
-                with ctx:
+                        np.zeros(Bp, np.int32), np.zeros(Bp, bool))
+                dargs = () if dC is None else (
+                    np.full((Bp, _DELTA_MATCH_CAP), -1, np.int32),
+                    np.zeros(Bp, np.int32), np.zeros(Bp, bool))
+                pos = (np.full(Bm, Bp, np.int32),)   # pad = Bp: dropped
+                label = f"warm W{Wp}xB{Bp}mB{Bm}" \
+                    + (f"d{dC}" if dC is not None else "")
+                with ctx_of(label):
                     if backend == "shapes":
-                        r = route_window_cached(
-                            tables, cursors, *args,
-                            np.zeros((Wp, Bp), np.int32),
-                            np.zeros((Wp, Bp), np.int32), strat,
-                            fanout_cap=self.fanout_cap,
-                            slot_cap=self.slot_cap)
+                        inv = np.zeros((Wp, Bp), np.int32)
+                        mh = np.zeros((Wp, Bp), np.int32)
+                        if dC is None:
+                            r = route_window_cached(
+                                tables, cursors, *args, *pos, inv, mh,
+                                strat, fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap)
+                        else:
+                            r = route_window_delta_cached(
+                                tables, dummy_delta(dC), cursors, *args,
+                                *dargs, *pos, inv, mh, strat,
+                                fanout_cap=self.fanout_cap,
+                                slot_cap=self.slot_cap,
+                                delta_match_cap=_DELTA_MATCH_CAP,
+                                delta_fanout_cap=_DELTA_FANOUT_CAP).res
                     else:
                         # trie plans are single-batch (Wp == 1)
-                        r = route_step_cached(
-                            tables, cursors, *args,
-                            np.zeros(Bp, np.int32),
-                            np.zeros(Bp, np.int32), strat,
-                            frontier_cap=self.frontier_cap,
-                            match_cap=self.match_cap,
-                            fanout_cap=self.fanout_cap,
-                            slot_cap=self.slot_cap)
+                        inv = np.zeros(Bp, np.int32)
+                        mh = np.zeros(Bp, np.int32)
+                        kw = dict(frontier_cap=self.frontier_cap,
+                                  match_cap=self.match_cap,
+                                  fanout_cap=self.fanout_cap,
+                                  slot_cap=self.slot_cap)
+                        if dC is None:
+                            r = route_step_cached(
+                                tables, cursors, *args, *pos, inv, mh,
+                                strat, **kw)
+                        else:
+                            r = route_step_delta_cached(
+                                tables, dummy_delta(dC), cursors, *args,
+                                *dargs, *pos, inv, mh, strat, **kw,
+                                delta_match_cap=_DELTA_MATCH_CAP,
+                                delta_fanout_cap=_DELTA_FANOUT_CAP).res
                     jax.block_until_ready(r.match_counts)
-                self._warm_classes.add((sig, Wp, Bp, Bm))
+                self._warm_classes.add(ck(sig, Wp, Bp, Bm=Bm, dC=dC))
             # demand-driven compact-readback classes (ISSUE 3): each
-            # (W, Bp[, Bm], P) is one program; the serving path reads
-            # back dense until its class lands here
+            # (W, Bp[, Bm][, dC], P) is one program; the serving path
+            # reads back dense until its class lands here
             from emqx_tpu.models.router_engine import (
                 route_step_cached_compact, route_step_compact,
-                route_window_cached_compact, route_window_full_compact)
-            for Wp, Bp, Bm, P in compact_missing:
+                route_step_delta_cached_compact, route_step_delta_compact,
+                route_window_cached_compact, route_window_delta_compact,
+                route_window_delta_cached_compact,
+                route_window_full_compact)
+            for Wp, Bp, Bm, P, dC in compact_missing:
                 label = f"warm W{Wp}xB{Bp}" \
-                    + (f"mB{Bm}" if Bm is not None else "") + f"c{P}"
-                ctx = tele.compile_context(label) \
-                    if tele is not None else contextlib.nullcontext()
-                with ctx:
+                    + (f"mB{Bm}" if Bm is not None else "") \
+                    + (f"d{dC}" if dC is not None else "") + f"c{P}"
+                dkw = dict(delta_match_cap=_DELTA_MATCH_CAP,
+                           delta_fanout_cap=_DELTA_FANOUT_CAP,
+                           d_payload_cap=self._delta_payload_cap(Bp))
+                with ctx_of(label):
                     if Bm is None:
                         enc = np.zeros((Wp, Bp, self.max_levels),
                                        np.int32)
                         z = np.zeros((Wp, Bp), np.int32)
                         zb = np.zeros((Wp, Bp), bool)
                         if backend == "shapes":
-                            r = route_window_full_compact(
-                                tables, cursors, enc, z, zb, z, strat,
-                                fanout_cap=self.fanout_cap,
-                                slot_cap=self.slot_cap, payload_cap=P)
+                            if dC is None:
+                                r = route_window_full_compact(
+                                    tables, cursors, enc, z, zb, z,
+                                    strat, fanout_cap=self.fanout_cap,
+                                    slot_cap=self.slot_cap,
+                                    payload_cap=P)
+                            else:
+                                r = route_window_delta_compact(
+                                    tables, dummy_delta(dC), cursors,
+                                    enc, z, zb, z, strat,
+                                    fanout_cap=self.fanout_cap,
+                                    slot_cap=self.slot_cap,
+                                    payload_cap=P, **dkw)
                         else:   # trie compact plans are single-batch
-                            r = route_step_compact(
-                                tables, cursors, enc[0], z[0], zb[0],
-                                z[0], strat,
-                                frontier_cap=self.frontier_cap,
-                                match_cap=self.match_cap,
-                                fanout_cap=self.fanout_cap,
-                                slot_cap=self.slot_cap, payload_cap=P)
+                            kw = dict(frontier_cap=self.frontier_cap,
+                                      match_cap=self.match_cap,
+                                      fanout_cap=self.fanout_cap,
+                                      slot_cap=self.slot_cap,
+                                      payload_cap=P)
+                            if dC is None:
+                                r = route_step_compact(
+                                    tables, cursors, enc[0], z[0],
+                                    zb[0], z[0], strat, **kw)
+                            else:
+                                r = route_step_delta_compact(
+                                    tables, dummy_delta(dC), cursors,
+                                    enc[0], z[0], zb[0], z[0], strat,
+                                    **kw, **dkw)
                     else:
                         args = (np.full((Bm, self.max_levels), I.PAD,
                                         np.int32),
@@ -1246,28 +1896,49 @@ class DeviceRouteEngine:
                                 np.zeros(Bm, bool),
                                 np.full((Bp, match_width), -1, np.int32),
                                 np.zeros(Bp, np.int32),
-                                np.zeros(Bp, bool),
-                                np.full(Bm, Bp, np.int32))
+                                np.zeros(Bp, bool))
+                        dargs = () if dC is None else (
+                            np.full((Bp, _DELTA_MATCH_CAP), -1,
+                                    np.int32),
+                            np.zeros(Bp, np.int32), np.zeros(Bp, bool))
+                        pos = (np.full(Bm, Bp, np.int32),)
                         if backend == "shapes":
-                            r = route_window_cached_compact(
-                                tables, cursors, *args,
-                                np.zeros((Wp, Bp), np.int32),
-                                np.zeros((Wp, Bp), np.int32), strat,
-                                fanout_cap=self.fanout_cap,
-                                slot_cap=self.slot_cap, payload_cap=P)
+                            inv = np.zeros((Wp, Bp), np.int32)
+                            mh = np.zeros((Wp, Bp), np.int32)
+                            if dC is None:
+                                r = route_window_cached_compact(
+                                    tables, cursors, *args, *pos, inv,
+                                    mh, strat,
+                                    fanout_cap=self.fanout_cap,
+                                    slot_cap=self.slot_cap,
+                                    payload_cap=P)
+                            else:
+                                r = route_window_delta_cached_compact(
+                                    tables, dummy_delta(dC), cursors,
+                                    *args, *dargs, *pos, inv, mh,
+                                    strat, fanout_cap=self.fanout_cap,
+                                    slot_cap=self.slot_cap,
+                                    payload_cap=P, **dkw)
                         else:
-                            r = route_step_cached_compact(
-                                tables, cursors, *args,
-                                np.zeros(Bp, np.int32),
-                                np.zeros(Bp, np.int32), strat,
-                                frontier_cap=self.frontier_cap,
-                                match_cap=self.match_cap,
-                                fanout_cap=self.fanout_cap,
-                                slot_cap=self.slot_cap, payload_cap=P)
+                            inv = np.zeros(Bp, np.int32)
+                            mh = np.zeros(Bp, np.int32)
+                            kw = dict(frontier_cap=self.frontier_cap,
+                                      match_cap=self.match_cap,
+                                      fanout_cap=self.fanout_cap,
+                                      slot_cap=self.slot_cap,
+                                      payload_cap=P)
+                            if dC is None:
+                                r = route_step_cached_compact(
+                                    tables, cursors, *args, *pos, inv,
+                                    mh, strat, **kw)
+                            else:
+                                r = route_step_delta_cached_compact(
+                                    tables, dummy_delta(dC), cursors,
+                                    *args, *dargs, *pos, inv, mh,
+                                    strat, **kw, **dkw)
                     jax.block_until_ready(r.compact.offsets)
                 self._warm_classes.add(
-                    (sig, Wp, Bp)
-                    + ((Bm,) if Bm is not None else ()) + (f"c{P}",))
+                    ck(sig, Wp, Bp, Bm=Bm, dC=dC, P=P))
 
         async def run():
             try:
@@ -1339,15 +2010,23 @@ class DeviceRouteEngine:
             dol4[k, :n] = dollar
         h = _Handle(subs, b, self.device_shared_active())
         h.enc = (enc4, len4, dol4)
+        seq_trie = b.backend != "shapes" and Wp > 1
+        if not seq_trie:
+            # delta overlay for this dispatch (None = host fallback for
+            # post-snapshot filters, exactly the pre-overlay behavior).
+            # The sequential multi-batch trie window has no single fused
+            # program to hang the overlay on — rare direct-caller path.
+            h.delta = self._gate_delta(Wp, Bp, gate_cold)
         if self.dedup:
             h.plan, h.cache_info = self._plan_window(b, enc4, len4, dol4,
-                                                     gate_cold)
-        if not (b.backend != "shapes" and Wp > 1 and h.plan is None):
+                                                     gate_cold, h.delta)
+        if not (seq_trie and h.plan is None):
             # CSR readback class for this dispatch (None = dense). The
             # excluded case is the rare plain multi-batch trie window,
             # which dispatches sequential steps and stacks host-side —
             # no single fused program to hang the compaction on.
-            h.pcap = self._gate_compact(Wp, Bp, h.plan, gate_cold)
+            h.pcap = self._gate_compact(Wp, Bp, h.plan, gate_cold,
+                                        h.delta)
         self._outstanding += 1
         self.node.metrics.inc("routing.device.windows")
         self.node.metrics.inc("routing.device.window_subs", W)
@@ -1439,11 +2118,11 @@ class DeviceRouteEngine:
         return [(id(m) >> 4) & 0x7FFFFFFF for m in msgs]  # random
 
     def _dispatch_inner(self, h) -> None:
-        from emqx_tpu.models.router_engine import (
-            route_step, route_step_cached, route_step_cached_compact,
-            route_step_compact, route_window_cached,
-            route_window_cached_compact, route_window_full,
-            route_window_full_compact)
+        """Select + run the fused program for this window: the plain
+        step/window, with up to three optional fused dimensions — dedup
+        plan (ISSUE 2), CSR readback (ISSUE 3), delta overlay
+        (ISSUE 4) — each independently warm-gated at prepare."""
+        from emqx_tpu.models import router_engine as RE
         from emqx_tpu.ops.shared import (STRATEGIES, STRATEGY_ROUND_ROBIN)
         broker = self.broker
         enc4, len4, dol4 = h.enc
@@ -1453,102 +2132,179 @@ class DeviceRouteEngine:
         msg_hash = np.zeros((Wp, Bp), np.int32)
         for k, (msgs, _w, _t) in enumerate(h.subs):
             msg_hash[k, :len(msgs)] = self._msg_hashes(msgs, strat_id)
-        p = h.plan
-        P = h.pcap
-        cres = None
+        strat = np.int32(strat_id)
+        p, P, ov = h.plan, h.pcap, h.delta
+        dC = ov.cap if ov is not None else None
+        shapes = h.built.backend == "shapes"
+        kw = dict(fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
+        if not shapes:
+            kw.update(frontier_cap=self.frontier_cap,
+                      match_cap=self.match_cap)
+        dkw = {} if ov is None else dict(
+            delta_match_cap=_DELTA_MATCH_CAP,
+            delta_fanout_cap=_DELTA_FANOUT_CAP)
+        ckw = {} if P is None else dict(payload_cap=P)
+        if P is not None and ov is not None:
+            ckw["d_payload_cap"] = self._delta_payload_cap(Bp)
 
-        if h.built.backend == "shapes":
-            if p is not None:
-                # deduplicated dispatch: shape-hash only the miss lanes,
-                # merge with the cache-hit base rows, scatter back to
-                # window width before the cursor-dependent post stage
-                args = (self._tables, self._cursors, p.miss_topics,
-                        p.miss_lens, p.miss_dollar, p.base_m, p.base_c,
-                        p.base_o, p.miss_pos, p.inv, msg_hash,
-                        np.int32(strat_id))
-                kw = dict(fanout_cap=self.fanout_cap,
-                          slot_cap=self.slot_cap)
-                if P is not None:
-                    cres = route_window_cached_compact(*args, **kw,
-                                                       payload_cap=P)
-                    res = cres.res
-                else:
-                    res = route_window_cached(*args, **kw)
-                self._warm_classes.add(
-                    (self._cur_sig, Wp, Bp, p.Bm)
-                    + ((f"c{P}",) if P is not None else ()))
-                self.node.metrics.inc("routing.device.cached_windows")
-            else:
-                args = (self._tables, self._cursors, enc4, len4, dol4,
-                        msg_hash, np.int32(strat_id))
-                kw = dict(fanout_cap=self.fanout_cap,
-                          slot_cap=self.slot_cap)
-                if P is not None:
-                    cres = route_window_full_compact(*args, **kw,
-                                                     payload_cap=P)
-                    res = cres.res
-                else:
-                    res = route_window_full(*args, **kw)
-                self._warm_classes.add(
-                    (self._cur_sig, Wp, Bp)
-                    + ((f"c{P}",) if P is not None else ()))
-            self._cursors = res.new_cursors[-1]
-        elif p is not None:
-            # trie + plan: single-batch only (_plan_window guarantees
-            # Wp == 1 — the trie backend never fuses)
-            args = (self._tables, self._cursors, p.miss_topics,
-                    p.miss_lens, p.miss_dollar, p.base_m, p.base_c,
-                    p.base_o, p.miss_pos, p.inv[0], msg_hash[0],
-                    np.int32(strat_id))
-            kw = dict(frontier_cap=self.frontier_cap,
-                      match_cap=self.match_cap,
-                      fanout_cap=self.fanout_cap, slot_cap=self.slot_cap)
-            if P is not None:
-                cres = route_step_cached_compact(*args, **kw,
-                                                 payload_cap=P)
-                res = cres.res          # already window-shaped (W = 1)
-                self._cursors = res.new_cursors[-1]
-            else:
-                import jax.numpy as jnp
-                r = route_step_cached(*args, **kw)
-                self._cursors = r.new_cursors
-                res = type(r)(*[jnp.stack([getattr(r, f)])
-                                for f in r._fields])
-            self._warm_classes.add(
-                (self._cur_sig, Wp, Bp, p.Bm)
-                + ((f"c{P}",) if P is not None else ()))
-            self.node.metrics.inc("routing.device.cached_windows")
-        elif P is not None:
-            # plain trie step + fused CSR (single-batch; prepare_window
-            # never assigns a payload class to a multi-batch trie window)
-            cres = route_step_compact(
-                self._tables, self._cursors, enc4[0], len4[0], dol4[0],
-                msg_hash[0], np.int32(strat_id),
-                frontier_cap=self.frontier_cap, match_cap=self.match_cap,
-                fanout_cap=self.fanout_cap, slot_cap=self.slot_cap,
-                payload_cap=P)
-            res = cres.res              # window-shaped (W = 1)
-            self._cursors = res.new_cursors[-1]
-            self._warm_classes.add((self._cur_sig, Wp, Bp, f"c{P}"))
-        else:
-            # trie backend has no window variant: dispatch sub-batches
-            # sequentially (rare path — >SHAPE_CAP distinct shapes)
+        if not shapes and p is None and ov is None and P is None:
+            # plain trie: no window variant — dispatch sub-batches
+            # sequentially and stack (rare path: >SHAPE_CAP distinct
+            # shapes with every fused dimension disabled or cold)
             import jax.numpy as jnp
             outs = []
             for k in range(Wp):
-                r = route_step(
-                    self._tables, self._cursors, enc4[k], len4[k],
-                    dol4[k], msg_hash[k], np.int32(strat_id),
-                    frontier_cap=self.frontier_cap,
-                    match_cap=self.match_cap, fanout_cap=self.fanout_cap,
-                    slot_cap=self.slot_cap)
+                r = RE.route_step(self._tables, self._cursors, enc4[k],
+                                  len4[k], dol4[k], msg_hash[k], strat,
+                                  **kw)
                 self._cursors = r.new_cursors
                 outs.append(r)
-            res = type(outs[0])(*[jnp.stack([getattr(o, f)
-                                            for o in outs])
-                                  for f in outs[0]._fields])
+            h.res = type(outs[0])(*[jnp.stack([getattr(o, f)
+                                              for o in outs])
+                                    for f in outs[0]._fields])
+            return
+
+        if p is not None:
+            # deduplicated dispatch: match only the miss lanes, merge
+            # with the cache-hit base rows, scatter back to window width
+            # before the cursor-dependent post stage (trie plans are
+            # single-batch: _plan_window guarantees Wp == 1 there)
+            base = (p.miss_topics, p.miss_lens, p.miss_dollar,
+                    p.base_m, p.base_c, p.base_o)
+            dbase = () if ov is None else (p.base_dm, p.base_dc,
+                                           p.base_do)
+            tail = (p.miss_pos, p.inv if shapes else p.inv[0],
+                    msg_hash if shapes else msg_hash[0], strat)
+            if ov is not None:
+                fn = (RE.route_window_delta_cached_compact
+                      if P is not None
+                      else RE.route_window_delta_cached) if shapes else \
+                    (RE.route_step_delta_cached_compact if P is not None
+                     else RE.route_step_delta_cached)
+                out = fn(self._tables, ov.dev, self._cursors, *base,
+                         *dbase, *tail, **kw, **dkw, **ckw)
+            else:
+                fn = (RE.route_window_cached_compact if P is not None
+                      else RE.route_window_cached) if shapes else \
+                    (RE.route_step_cached_compact if P is not None
+                     else RE.route_step_cached)
+                out = fn(self._tables, self._cursors, *base, *tail,
+                         **kw, **ckw)
+            self.node.metrics.inc("routing.device.cached_windows")
+            warm_key = self._class_key(self._cur_sig, Wp, Bp, Bm=p.Bm,
+                                       dC=dC, P=P)
+        else:
+            args4 = (enc4, len4, dol4, msg_hash) if shapes else \
+                (enc4[0], len4[0], dol4[0], msg_hash[0])
+            if ov is not None:
+                fn = (RE.route_window_delta_compact if P is not None
+                      else RE.route_window_delta) if shapes else \
+                    (RE.route_step_delta_compact if P is not None
+                     else RE.route_step_delta)
+                out = fn(self._tables, ov.dev, self._cursors, *args4,
+                         strat, **kw, **dkw, **ckw)
+            else:
+                fn = (RE.route_window_full_compact if P is not None
+                      else RE.route_window_full) if shapes else \
+                    RE.route_step_compact   # plain trie without P
+                                            # returned above
+                out = fn(self._tables, self._cursors, *args4, strat,
+                         **kw, **ckw)
+            warm_key = self._class_key(self._cur_sig, Wp, Bp, dC=dC,
+                                       P=P)
+
+        # unwrap the result family; every remaining variant is
+        # window-shaped except the bare cached trie step
+        if isinstance(out, RE.CompactDeltaRouteResult):
+            res = out.dres.res
+            h.dres = out.dres.dp
+            h.cres = out.compact
+            h.dcres = out.d_compact
+        elif isinstance(out, RE.DeltaRouteResult):
+            res = out.res
+            h.dres = out.dp
+        elif isinstance(out, RE.CompactRouteResult):
+            res = out.res
+            h.cres = out.compact
+        else:
+            res = out
+            if not shapes and p is not None:
+                import jax.numpy as jnp
+                res = type(res)(*[jnp.stack([getattr(res, f)])
+                                  for f in res._fields])
+        self._cursors = res.new_cursors[-1]
+        self._warm_classes.add(warm_key)
         h.res = res
-        h.cres = cres.compact if cres is not None else None
+
+    def _materialize_delta(self, h) -> int:
+        """Read back the delta-overlay planes (when this dispatch fused
+        the overlay): the small count/overflow planes always, plus
+        either the delta CSR payload or — on delta payload overflow, or
+        without a payload class — the dense fid/row/opts planes of the
+        same program. Returns the transferred byte count (billed into
+        the window's readback bucket by the caller)."""
+        dp = h.dres
+        if dp is None:
+            return 0
+        counts = np.asarray(dp.counts)
+        mov = np.asarray(dp.moverflow)
+        ovf = np.asarray(dp.overflow)
+        nbytes = counts.nbytes + mov.nbytes + ovf.nbytes
+        dcp = h.dcres
+        if dcp is not None:
+            off = np.asarray(dcp.offsets)
+            c3 = np.asarray(dcp.counts3)
+            rovf = np.asarray(dcp.row_overflow)
+            nbytes += off.nbytes + c3.nbytes + rovf.nbytes
+            if rovf.any():
+                self.node.metrics.inc(
+                    "routing.device.delta_compact_overflow")
+                dcp = None      # dense delta planes below
+            else:
+                pay = np.asarray(dcp.payload)
+                nbytes += pay.nbytes
+                h.np_delta = _DeltaCsr(off, c3, pay, counts, mov, ovf)
+                return nbytes
+        fids = np.asarray(dp.fids)
+        rows = np.asarray(dp.rows)
+        opts = np.asarray(dp.opts)
+        nbytes += fids.nbytes + rows.nbytes + opts.nbytes
+        h.np_delta = _DeltaRes(fids, counts, mov, rows, opts, ovf)
+        return nbytes
+
+    def _delta_cache_fields(self, h, lane: int, Bp: int) -> tuple:
+        """Fields 3.. of a match-cache row under the delta overlay:
+        (delta fids, delta count, MATCH-level delta overflow, encoded
+        topic, len, is_dollar) — the overlay base triple in FID space
+        (stable across overlay row reassignment) plus the topic encoding
+        the delta-aware invalidation matches against. Empty () with the
+        overlay knob off, so the pre-overlay 3-tuple rows (and their
+        tests) are bit-exact."""
+        if not self.delta_overlay:
+            return ()
+        enc4, len4, dol4 = h.enc
+        w, bb = divmod(lane, Bp)
+        topic = (enc4[w, bb].copy(), int(len4[w, bb]),
+                 bool(dol4[w, bb]))
+        nd = h.np_delta
+        if nd is None:
+            if self._delta_filter:
+                # overlay exists but this dispatch ran without it (cold
+                # class): the delta part of this topic is UNKNOWN — a
+                # None marker keeps the main row usable while making the
+                # row ineligible as a cached delta base (_plan_window)
+                return (None, 0, False) + topic
+            dm = np.full(_DELTA_MATCH_CAP, -1, np.int32)
+            return (dm, 0, False) + topic
+        if isinstance(nd, _DeltaCsr):
+            o = int(nd.off[w, bb])
+            cm = int(nd.c3[w, bb, 0])
+            dm = np.full(_DELTA_MATCH_CAP, -1, np.int32)
+            dm[:cm] = nd.pay[w, o:o + cm]
+        else:
+            dm = nd.fids[w, bb].copy()
+        return (dm, int(nd.counts[w, bb]), bool(nd.moverflow[w, bb])) \
+            + topic
 
     def materialize(self, h) -> None:
         """Stage 3 (executor thread): blocking device→host readbacks.
@@ -1571,6 +2327,7 @@ class DeviceRouteEngine:
         t0 = time.perf_counter()
         res = h.res
         cp = h.cres
+        delta_bytes = self._materialize_delta(h)
         csr_probe_bytes = 0
         if cp is not None:
             off = np.asarray(cp.offsets)
@@ -1593,7 +2350,8 @@ class DeviceRouteEngine:
                 h.np_res = _CsrRes(off, c3, pay, overflow, occur)
                 metrics.inc("pipeline.readback.bytes.compact",
                             off.nbytes + c3.nbytes + pay.nbytes
-                            + overflow.nbytes + occur.nbytes)
+                            + overflow.nbytes + occur.nbytes
+                            + delta_bytes)
                 metrics.inc("pipeline.readback.windows.compact")
                 info = h.cache_info
                 if info is not None and self._match_cache is not None:
@@ -1612,8 +2370,11 @@ class DeviceRouteEngine:
                         cm = int(c3[w, bb, 0])
                         row = np.full(mw, -1, np.int32)
                         row[:cm] = pay[w, off[w, bb]:off[w, bb] + cm]
-                        items.append((key, (row, cm, bool(o_flat[lane]))))
-                    self._match_cache.put_many(info.sid, items)
+                        items.append((key, (row, cm, bool(o_flat[lane]))
+                                      + self._delta_cache_fields(h, lane,
+                                                                 Bp)))
+                    self._match_cache.put_many(info.sid, items,
+                                               version=info.version)
                 if tele is not None:
                     tele.observe_stage("materialize",
                                        time.perf_counter() - t0)
@@ -1622,7 +2383,8 @@ class DeviceRouteEngine:
                     np.asarray(res.opts), np.asarray(res.shared_sids),
                     np.asarray(res.shared_rows), np.asarray(res.shared_opts),
                     np.asarray(res.overflow), np.asarray(res.occur))
-        dense_bytes = sum(a.nbytes for a in h.np_res) + csr_probe_bytes
+        dense_bytes = sum(a.nbytes for a in h.np_res) + csr_probe_bytes \
+            + delta_bytes
         info = h.cache_info
         if info is not None and self._match_cache is not None:
             # the match_counts readback is only paid when there are rows
@@ -1631,6 +2393,7 @@ class DeviceRouteEngine:
             h.np_counts = np.asarray(res.match_counts)
             dense_bytes += h.np_counts.nbytes
             matches, overflow = h.np_res[0], h.np_res[6]
+            Bp = matches.shape[1]
             mw = matches.shape[-1]
             mflat = matches.reshape(-1, mw)
             cflat = h.np_counts.reshape(-1)
@@ -1641,8 +2404,9 @@ class DeviceRouteEngine:
             # result stays bit-identical to a cold match
             self._match_cache.put_many(
                 info.sid,
-                [(k, (mflat[i].copy(), int(cflat[i]), bool(oflat[i])))
-                 for k, i in info.inserts])
+                [(k, (mflat[i].copy(), int(cflat[i]), bool(oflat[i]))
+                  + self._delta_cache_fields(h, i, Bp))
+                 for k, i in info.inserts], version=info.version)
         metrics.inc("pipeline.readback.bytes.dense", dense_bytes)
         metrics.inc("pipeline.readback.windows.dense")
         if tele is not None:
@@ -1674,6 +2438,15 @@ class DeviceRouteEngine:
                 (matches, rows, opts, shared_sids, shared_rows,
                  shared_opts, overflow, occur) = nr
                 overflow_k, occur_k = overflow[k], occur[k]
+            nd = h.np_delta
+            d_counts_k = None
+            if nd is not None:
+                # a delta-plane overflow (match cap or fan cap) means
+                # the message's post-snapshot matches are incomplete:
+                # full host fallback, same contract as the main planes
+                overflow_k = overflow_k | nd.overflow[k]
+                d_counts_k = nd.counts[k]
+            pending = self._delta_pending(h.delta)
             if h.dev_shared and b.n_slots:
                 self._writeback_cursors(occur_k, b)
             metrics = self.node.metrics
@@ -1681,11 +2454,12 @@ class DeviceRouteEngine:
             if csr:
                 fast = self._consume_batch_fast_csr(
                     msgs, nr.off[k], nr.c3[k], nr.pay[k], too_long,
-                    overflow_k, h.dev_shared, b)
+                    overflow_k, h.dev_shared, b, d_counts_k, pending)
             else:
                 fast = self._consume_batch_fast(
                     msgs, matches[k], rows[k], opts[k], shared_sids[k],
-                    too_long, overflow_k, h.dev_shared, b)
+                    too_long, overflow_k, h.dev_shared, b, d_counts_k,
+                    pending)
             counts: list[int] = []
             for i, msg in enumerate(msgs):
                 if fast[i] is not None:
@@ -1707,10 +2481,19 @@ class DeviceRouteEngine:
                     row6 = (matches[k][i], rows[k][i], opts[k][i],
                             shared_sids[k][i], shared_rows[k][i],
                             shared_opts[k][i])
+                drow = None
+                if nd is not None:
+                    if isinstance(nd, _DeltaCsr):
+                        drow = csr_slices(nd.off[k], nd.c3[k],
+                                          nd.pay[k], i)[:3]
+                    else:
+                        drow = (nd.fids[k][i], nd.rows[k][i],
+                                nd.opts[k][i])
                 counts.append(self._consume_one(
                     msg, *row6,
                     words_list[i] if words_list is not None else None,
-                    h.dev_shared, b))
+                    h.dev_shared, b, drow=drow, ov=h.delta,
+                    pending=pending))
             metrics.inc("routing.device.batches")
             return counts
         finally:
@@ -1719,16 +2502,17 @@ class DeviceRouteEngine:
             self._release_one(h)
 
     def _consume_batch_fast(self, msgs, m_k, r_k, o_k, ss_k, too_long,
-                            overflow_k, dev_shared: bool, b):
+                            overflow_k, dev_shared: bool, b,
+                            d_counts_k=None, pending: bool = False):
         """Vectorized consume for provably-clean messages. Returns a list
         with per-message delivery counts, or None where the slow path
         must run. Clean requires, globally: standalone node (no cluster
-        forward / cluster group sweep), no delta filters, no
-        post-snapshot shared groups; per message: no too-long/overflow,
-        no dirty/rich matched filter, and no shared involvement (no
-        device slot matched; no matched filter with host shared
-        groups)."""
-        if (self.broker.cluster is not None or self._delta_filter
+        forward / cluster group sweep), no delta filters beyond the
+        fused overlay (`pending`), no post-snapshot shared groups; per
+        message: no too-long/overflow, no dirty/rich matched filter, no
+        delta-overlay match, and no shared involvement (no device slot
+        matched; no matched filter with host shared groups)."""
+        if (self.broker.cluster is not None or pending
                 or self.new_slots_by_filter):
             return [None] * len(msgs)
         B = len(msgs)
@@ -1741,15 +2525,17 @@ class DeviceRouteEngine:
             return r_k[row_msg, col], o_k[row_msg, col]
 
         return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
-                                  shared_any, fetch, dev_shared, b)
+                                  shared_any, fetch, dev_shared, b,
+                                  d_counts_k)
 
     def _consume_batch_fast_csr(self, msgs, off_k, c3_k, pay_k, too_long,
-                                overflow_k, dev_shared: bool, b):
+                                overflow_k, dev_shared: bool, b,
+                                d_counts_k=None, pending: bool = False):
         """_consume_batch_fast over one window row's CSR planes: same
         clean-message proof and the same vectorized delivery walk, with
         the 2-D plane gathers replaced by flat payload gathers at each
         message's family base offsets."""
-        if (self.broker.cluster is not None or self._delta_filter
+        if (self.broker.cluster is not None or pending
                 or self.new_slots_by_filter):
             return [None] * len(msgs)
         B = len(msgs)
@@ -1774,10 +2560,12 @@ class DeviceRouteEngine:
                     pay_k[obase[row_msg] + col])
 
         return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
-                                  shared_any, fetch, dev_shared, b)
+                                  shared_any, fetch, dev_shared, b,
+                                  d_counts_k)
 
     def _fast_deliver(self, msgs, mi, fids, too_long, overflow_k,
-                      shared_any, fetch, dev_shared: bool, b):
+                      shared_any, fetch, dev_shared: bool, b,
+                      d_counts_k=None):
         """Shared tail of the vectorized fast consume (dense and CSR):
         per-message clean proof, row attribution, delivery, and the
         no-subscriber bookkeeping. `mi`/`fids` list every valid match
@@ -1797,6 +2585,13 @@ class DeviceRouteEngine:
                     hostside[fid] = True
 
         slow = np.asarray(too_long[:B]) | (overflow_k[:B] != 0)
+        if d_counts_k is not None:
+            # overlay-matched messages walk the slow path (delta fan-out
+            # is per-filter segmented like the main rows, but mixing the
+            # two fid spaces into one vectorized gather isn't worth the
+            # complexity for the churn tail — only DELTA-matched lanes
+            # pay, everything else stays fast)
+            slow |= d_counts_k[:B] > 0
         if fids.size:
             np.logical_or.at(slow, mi, hostside[fids] | b.fid_shared[fids])
         if dev_shared:
@@ -1894,7 +2689,9 @@ class DeviceRouteEngine:
         if self._outstanding == 0 \
                 and (self._built is None
                      or (not self._building
-                         and self.staleness() >= self.rebuild_threshold)):
+                         and self._compaction_reason() is not None)):
+            if self._built is not None:
+                self._count_compaction(self._compaction_reason())
             self.rebuild()
         # sync callers compile in-path by design — let a cold cached
         # class trace instead of bouncing to the plain program
@@ -1927,8 +2724,17 @@ class DeviceRouteEngine:
                 g.cursor = (g.cursor + int(occur[slot])) % len(g.members)
 
     def _consume_one(self, msg, m_row, r_row, o_row, ss_row, sr_row, so_row,
-                     words, dev_shared: bool, b=None) -> int:
-        """Turn one message's RouteResult rows into deliveries."""
+                     words, dev_shared: bool, b=None, drow=None, ov=None,
+                     pending: bool = False) -> int:
+        """Turn one message's RouteResult rows into deliveries.
+
+        `drow` = (delta fids, delta fan rows, delta fan opts) when the
+        dispatch fused the delta overlay `ov` (ISSUE 4): post-snapshot
+        filters deliver straight from the device planes; `pending`
+        marks live delta filters the overlay does NOT cover (just
+        subscribed / overflowed / too deep) — only those still walk the
+        host trie, and overlay-covered fids are skipped there so nothing
+        delivers twice."""
         broker = self.broker
         metrics = self.node.metrics
         b = b or self._built
@@ -1957,18 +2763,58 @@ class DeviceRouteEngine:
                         metrics.inc("messages.routed.device")
             off += seg
 
-        # filters added since the snapshot: host trie + host dispatch
-        if self._delta_filter:
+        # filters added since the snapshot (ISSUE 4): the fused overlay
+        # planes deliver them from device rows; only uncovered filters
+        # (no overlay this dispatch, overlay overflow, too-deep) walk
+        # the host trie — the routing.device.host_delta counter measures
+        # exactly those host-side deliveries (the pre-overlay behavior)
+        if ov is not None and drow is not None:
+            d_fids, d_rows, d_opts = drow
+            doff = 0
+            for raw in d_fids:
+                dfid = int(raw)
+                if dfid < 0:
+                    continue
+                seg = ov.seg_of.get(dfid, 0)
+                f = self._delta_filter.get(dfid)
+                if f is None:       # deleted while this batch flew
+                    doff += seg
+                    continue
+                matched.append(f)
+                if dfid in ov.hostfan \
+                        or self._fid_member_clock.get(dfid, -1) \
+                        > ov.version:
+                    # rich/oversized fan-out, or membership changed
+                    # after this overlay version was built: the match
+                    # stands, delivery comes from the live host dict
+                    n += broker.dispatch(f, msg)
+                else:
+                    for j in range(doff, doff + seg):
+                        sid = int(d_rows[j])
+                        if sid < 0:
+                            continue
+                        if broker._deliver(sid, f, msg,
+                                           _unpack_opts(int(d_opts[j]))):
+                            n += 1
+                            metrics.inc("messages.routed.device")
+                doff += seg
+        if self._delta_filter and (ov is None or pending):
             if words is None:   # prepare defers tokenization (native
                 words = T.tokens(msg.topic)[:self.max_levels]  # encode)
             ids = self.intern.encode_topic(words)
             dol = words[0].startswith("$") if words else False
+            host_hit = False
             for dfid in self._delta_trie.match(ids, dol):
+                if ov is not None and dfid in ov.fid_set:
+                    continue    # the overlay planes already served it
                 f = self._delta_filter.get(dfid)
                 if f is None:
                     continue
                 matched.append(f)
                 n += broker.dispatch(f, msg)
+                host_hit = True
+            if host_hit:
+                metrics.inc("routing.device.host_delta")
 
         # shared subscriptions
         if dev_shared:
@@ -2065,8 +2911,28 @@ class DeviceRouteEngine:
             broker.hooks.run("message.dropped", (msg, "no_subscribers"))
         return n
 
+    def rebuild_state(self) -> dict:
+        """Live rebuild/overlay gauges for the telemetry snapshot's
+        `rebuild` section (PipelineTelemetry.rebuild_state_fn): counts
+        ride the Metrics registry; these are the point-in-time values a
+        counter can't carry."""
+        ov = self._overlay
+        return {
+            "journal_depth": self.journal_depth(),
+            "building": self._building,
+            "staleness": self.staleness(),
+            "tombstones": len(self._built_deleted),
+            "delta_overlay": self.delta_overlay,
+            "overlay_rows": ov.n if ov is not None else 0,
+            "overlay_class": ov.cap if ov is not None else 0,
+            "overlay_version": ov.version if ov is not None else None,
+            "overlay_uncovered": self._overlay_uncovered,
+            "delta_filters": len(self._delta_filter),
+        }
+
     def stats(self) -> dict:
         b = self._built
+        ov = self._overlay
         return {
             "built": b is not None,
             "backend": b.backend if b else None,
@@ -2083,4 +2949,10 @@ class DeviceRouteEngine:
             "compact_readback": self.compact_readback,
             "payload_ewma": {k: round(v, 1)
                              for k, v in self._pay_ewma.items()},
+            "delta_overlay": self.delta_overlay,
+            "overlay": {"rows": ov.n, "class": ov.cap,
+                        "version": ov.version,
+                        "hostfan": len(ov.hostfan)}
+            if ov is not None else None,
+            "journal_depth": self.journal_depth(),
         }

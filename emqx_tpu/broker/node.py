@@ -46,9 +46,15 @@ class Node:
             self.metrics, hooks=self.hooks,
             slow_batch_s=(slow_ms / 1000.0) if slow_ms else None,
             track_compiles=use_device)
+        # rebuild threshold: config beats EMQX_TPU_REBUILD_THRESHOLD
+        # beats the built-in default (one resolution shared by the host
+        # router and both device engines)
+        from emqx_tpu.broker.device_engine import resolve_rebuild_threshold
+        rebuild_threshold = resolve_rebuild_threshold(
+            perf.get("rebuild_threshold"))
         self.router = Router(
             use_device=use_device,
-            rebuild_threshold=perf.get("rebuild_threshold", 256),
+            rebuild_threshold=rebuild_threshold,
             device_min_batch=perf.get("device_min_batch", 4))
         self.broker = Broker(
             router=self.router, hooks=self.hooks, metrics=self.metrics,
@@ -70,7 +76,11 @@ class Node:
                 fanout_cap=perf.get("device_fanout_cap", 128),
                 slot_cap=perf.get("device_slot_cap", 16),
                 max_batch=mc.get("max_batch", 256),
-                compact_readback=perf.get("compact_readback"))
+                compact_readback=perf.get("compact_readback"),
+                # churn knob (ISSUE 4): the mesh's churn path is already
+                # incremental (per-shard compaction) — the knob is
+                # accepted for config parity and surfaced in stats
+                delta_overlay=perf.get("delta_overlay"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
@@ -81,7 +91,7 @@ class Node:
             from emqx_tpu.broker.device_engine import DeviceRouteEngine
             self.device_engine = DeviceRouteEngine(
                 self,
-                rebuild_threshold=perf.get("rebuild_threshold", 256),
+                rebuild_threshold=rebuild_threshold,
                 fanout_cap=perf.get("device_fanout_cap", 128),
                 slot_cap=perf.get("device_slot_cap", 16),
                 # device-match reuse layers (None = env / built-in
@@ -90,7 +100,10 @@ class Node:
                 dedup=perf.get("topic_dedup"),
                 # CSR readback compaction A/B knob (ISSUE 3; None =
                 # EMQX_TPU_COMPACT_READBACK / default-on)
-                compact_readback=perf.get("compact_readback"))
+                compact_readback=perf.get("compact_readback"),
+                # delta-overlay A/B knob (ISSUE 4; None =
+                # EMQX_TPU_DELTA_OVERLAY / default-on)
+                delta_overlay=perf.get("delta_overlay"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),

@@ -114,6 +114,11 @@ class PipelineTelemetry:
                  track_compiles: bool = True):
         self.metrics = metrics if metrics is not None else Metrics()
         self.hooks = hooks
+        # live rebuild/overlay gauges provider (set by the device
+        # engine): journal depth, overlay size etc. — point-in-time
+        # values the counter registry can't carry. Best-effort: snapshot
+        # must keep working on nodes without a device engine.
+        self.rebuild_state_fn = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -154,6 +159,19 @@ class PipelineTelemetry:
                 self.hooks.run("batch.slow",
                                (dict(meta, duration_ms=round(
                                    seconds * 1000, 3)),))
+
+    # ---- rebuild stages (ISSUE 4) ---------------------------------------
+    # capture/build/warm/swap spans of the snapshot rebuild machinery
+    # plus delta_apply (overlay refresh) — rebuilds used to be invisible
+    # beyond a bare routing.device.rebuilds counter; these histograms
+    # ride the registry so all four exporters carry them, and snapshot()
+    # derives the `rebuild` section from them.
+    REBUILD_STAGES = ("capture", "build", "warm", "swap", "delta_apply")
+
+    def observe_rebuild(self, stage: str, seconds: float) -> None:
+        self.metrics.hist(f"pipeline.rebuild.{stage}.seconds",
+                          lo=_STAGE_LO,
+                          n_buckets=_STAGE_BUCKETS).observe(seconds)
 
     # ---- occupancy -------------------------------------------------------
     def record_occupancy(self, cls: str, fill: float) -> None:
@@ -306,6 +324,45 @@ class PipelineTelemetry:
             readback["reduction"] = round(
                 readback["bytes_per_window_dense"]
                 / readback["bytes_per_window_compact"], 2)
+        # rebuild machinery (ISSUE 4): stage spans + counts + compaction
+        # reasons + the engine's live gauges (journal depth, overlay
+        # size) — the section that makes rebuilds visible beyond the
+        # bare routing.device.rebuilds counter
+        rebuild = {}
+        rb_stages = {}
+        prefix_r = "pipeline.rebuild."
+        for name, h in self.metrics.histograms().items():
+            if name.startswith(prefix_r) and h.count:
+                snap = h.snapshot()
+                rb_stages[name[len(prefix_r):]
+                          .removesuffix(".seconds")] = {
+                    "count": snap["count"],
+                    "mean_ms": round(snap["mean"] * 1000, 4),
+                    "p95_ms": round(snap["p95"] * 1000, 4),
+                }
+        if rb_stages:
+            rebuild["stages"] = rb_stages
+        for k in ("routing.device.rebuilds",
+                  "routing.device.compactions",
+                  "routing.device.rebuild_failed",
+                  "routing.device.delta_applies",
+                  "routing.device.host_delta",
+                  "routing.device.cold_delta_class",
+                  "routing.device.delta_compact_overflow",
+                  "match_cache.delta_invalidated"):
+            v = self.metrics.val(k)
+            if v:
+                rebuild[k.rsplit(".", 1)[1]] = v
+        reasons = {k.rsplit(".", 1)[1]: v
+                   for k, v in self.metrics.all().items()
+                   if k.startswith("routing.device.compaction.")}
+        if reasons:
+            rebuild["compaction_reasons"] = reasons
+        if self.rebuild_state_fn is not None:
+            try:
+                rebuild["state"] = self.rebuild_state_fn()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -313,6 +370,8 @@ class PipelineTelemetry:
             "compiles": compiles,
             "decisions": decisions,
         }
+        if rebuild:
+            out["rebuild"] = rebuild
         if cache:
             out["match_cache"] = cache
         if dedup:

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from emqx_tpu.ops.delta import (DeltaPlanes, DeltaTables, delta_expand,
+                                delta_match)
 from emqx_tpu.ops.fanout import FanoutResult, SubTable, fanout_normal, shared_slots
 from emqx_tpu.ops.match import MatchResult, match_batch, merge_match_results
 from emqx_tpu.ops.shapes import ShapeTables, shape_match
@@ -292,6 +294,314 @@ def route_window_cached_compact(tables: ShapeRouterTables,
     return _with_compact(r, payload_cap, match_holes=True)
 
 
+class DeltaRouteResult(NamedTuple):
+    """A route result with its fused delta-overlay planes (ops.delta).
+
+    `res` is the main-snapshot RouteResult, window-shaped [W, ...] for
+    every variant (single-batch trie steps lift to W = 1 like the
+    compact twins); `dp` carries the overlay's match + fan-out planes,
+    each [W, B, ...]. The two fid spaces are disjoint by construction:
+    `res.matches` are built-snapshot fids, `dp.fids` are the engine's
+    delta fids — the host consume walks both, so a filter subscribed
+    one window ago delivers from THIS dispatch instead of host-routing
+    (the churn hole ISSUE 4 closes)."""
+    res: RouteResult
+    dp: DeltaPlanes           # every field [W, B, ...]
+
+
+class CompactDeltaRouteResult(NamedTuple):
+    """DeltaRouteResult + fused CSR readbacks for BOTH plane families.
+
+    `compact` is the main planes' CSR (ops.compact); `d_compact` the
+    overlay planes' CSR, reusing the same op with an empty shared
+    family (cs == 0 in every row) so `csr_slices` decodes both with one
+    code path. The dense planes stay in `dres` as free same-program
+    outputs — either CSR overflowing its payload class falls back to
+    the corresponding dense planes with no re-dispatch."""
+    dres: DeltaRouteResult
+    compact: "CompactPlanes"      # noqa: F821 — imported lazily
+    d_compact: "CompactPlanes"    # noqa: F821
+
+
+def _window_delta(delta: DeltaTables, topics: jax.Array, lens: jax.Array,
+                  is_dollar: jax.Array, *, dmatch_cap: int,
+                  dfan_cap: int) -> DeltaPlanes:
+    """Overlay planes for a full [W, B] window: the linear matcher is
+    cursor-independent, so it runs ONCE over the flattened lanes instead
+    of per scan step."""
+    W, B = topics.shape[:2]
+    mr = delta_match(delta, topics.reshape(W * B, -1),
+                     lens.reshape(W * B), is_dollar.reshape(W * B),
+                     match_cap=dmatch_cap)
+    dp = delta_expand(delta, mr, fanout_cap=dfan_cap)
+    return DeltaPlanes(*[x.reshape((W, B) + x.shape[1:]) for x in dp])
+
+
+def _cached_delta(delta: DeltaTables, miss_topics, miss_lens, miss_dollar,
+                  base_dm, base_dc, base_do, miss_pos, inv, *,
+                  dmatch_cap: int, dfan_cap: int) -> DeltaPlanes:
+    """Overlay planes for a DEDUPLICATED dispatch: the linear matcher
+    runs only on the [Bm] miss lanes; cache-hit unique topics ride in as
+    host-filled base rows (overlay ROW indices + counts + MATCH-level
+    overflow) merged with the same scatter as the main match
+    (ops.match.merge_match_results), then fan-out expands the merged
+    unique rows against the CURRENT overlay CSR — so cached rows carry
+    no membership state and a subscriber change can never stale them —
+    and `inv` gathers back to full width."""
+    mr = delta_match(delta, miss_topics, miss_lens, miss_dollar,
+                     match_cap=dmatch_cap)
+    um = merge_match_results(base_dm, base_dc, base_do, mr, miss_pos)
+    dp_u = delta_expand(delta, um, fanout_cap=dfan_cap)
+    return DeltaPlanes(*[x[inv] for x in dp_u])
+
+
+def _stack1_dp(dp: DeltaPlanes) -> DeltaPlanes:
+    return DeltaPlanes(*[x[None] for x in dp])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "delta_match_cap", "delta_fanout_cap"))
+def route_step_delta(tables: RouterTables, delta: DeltaTables,
+                     cursors: jax.Array, topics: jax.Array,
+                     lens: jax.Array, is_dollar: jax.Array,
+                     msg_hash: jax.Array, strategy: jax.Array, *,
+                     frontier_cap: int = 16, match_cap: int = 64,
+                     fanout_cap: int = 128, slot_cap: int = 16,
+                     delta_match_cap: int = 16,
+                     delta_fanout_cap: int = 64) -> DeltaRouteResult:
+    """Trie-NFA route step + delta overlay in one dispatch (W = 1)."""
+    r = route_step(tables, cursors, topics, lens, is_dollar, msg_hash,
+                   strategy, frontier_cap=frontier_cap,
+                   match_cap=match_cap, fanout_cap=fanout_cap,
+                   slot_cap=slot_cap)
+    dp = delta_expand(delta, delta_match(delta, topics, lens, is_dollar,
+                                         match_cap=delta_match_cap),
+                      fanout_cap=delta_fanout_cap)
+    return DeltaRouteResult(res=_stack1(r), dp=_stack1_dp(dp))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout_cap", "slot_cap", "delta_match_cap",
+                     "delta_fanout_cap"))
+def route_window_delta(tables: ShapeRouterTables, delta: DeltaTables,
+                       cursors: jax.Array, topics: jax.Array,
+                       lens: jax.Array, is_dollar: jax.Array,
+                       msg_hash: jax.Array, strategy: jax.Array, *,
+                       fanout_cap: int = 128, slot_cap: int = 16,
+                       delta_match_cap: int = 16,
+                       delta_fanout_cap: int = 64) -> DeltaRouteResult:
+    """route_window_full + delta overlay fused in the same dispatch."""
+    r = route_window_full(tables, cursors, topics, lens, is_dollar,
+                          msg_hash, strategy, fanout_cap=fanout_cap,
+                          slot_cap=slot_cap)
+    dp = _window_delta(delta, topics, lens, is_dollar,
+                       dmatch_cap=delta_match_cap,
+                       dfan_cap=delta_fanout_cap)
+    return DeltaRouteResult(res=r, dp=dp)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "delta_match_cap", "delta_fanout_cap"))
+def route_step_delta_cached(tables: RouterTables, delta: DeltaTables,
+                            cursors: jax.Array, miss_topics: jax.Array,
+                            miss_lens: jax.Array, miss_dollar: jax.Array,
+                            base_matches: jax.Array,
+                            base_counts: jax.Array,
+                            base_overflow: jax.Array,
+                            base_dm: jax.Array, base_dc: jax.Array,
+                            base_do: jax.Array, miss_pos: jax.Array,
+                            inv: jax.Array, msg_hash: jax.Array,
+                            strategy: jax.Array, *,
+                            frontier_cap: int = 16, match_cap: int = 64,
+                            fanout_cap: int = 128, slot_cap: int = 16,
+                            delta_match_cap: int = 16,
+                            delta_fanout_cap: int = 64
+                            ) -> DeltaRouteResult:
+    """Deduplicated trie step + delta overlay (cached base rows carry
+    BOTH fid spaces; see _cached_delta for the merge contract)."""
+    r = route_step_cached(tables, cursors, miss_topics, miss_lens,
+                          miss_dollar, base_matches, base_counts,
+                          base_overflow, miss_pos, inv, msg_hash,
+                          strategy, frontier_cap=frontier_cap,
+                          match_cap=match_cap, fanout_cap=fanout_cap,
+                          slot_cap=slot_cap)
+    dp = _cached_delta(delta, miss_topics, miss_lens, miss_dollar,
+                       base_dm, base_dc, base_do, miss_pos, inv,
+                       dmatch_cap=delta_match_cap,
+                       dfan_cap=delta_fanout_cap)
+    return DeltaRouteResult(res=_stack1(r), dp=_stack1_dp(dp))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout_cap", "slot_cap", "delta_match_cap",
+                     "delta_fanout_cap"))
+def route_window_delta_cached(tables: ShapeRouterTables,
+                              delta: DeltaTables, cursors: jax.Array,
+                              miss_topics: jax.Array,
+                              miss_lens: jax.Array,
+                              miss_dollar: jax.Array,
+                              base_matches: jax.Array,
+                              base_counts: jax.Array,
+                              base_overflow: jax.Array,
+                              base_dm: jax.Array, base_dc: jax.Array,
+                              base_do: jax.Array, miss_pos: jax.Array,
+                              inv: jax.Array, msg_hash: jax.Array,
+                              strategy: jax.Array, *,
+                              fanout_cap: int = 128, slot_cap: int = 16,
+                              delta_match_cap: int = 16,
+                              delta_fanout_cap: int = 64
+                              ) -> DeltaRouteResult:
+    """route_window_cached + delta overlay fused in the same dispatch."""
+    r = route_window_cached(tables, cursors, miss_topics, miss_lens,
+                            miss_dollar, base_matches, base_counts,
+                            base_overflow, miss_pos, inv, msg_hash,
+                            strategy, fanout_cap=fanout_cap,
+                            slot_cap=slot_cap)
+    dp = _cached_delta(delta, miss_topics, miss_lens, miss_dollar,
+                       base_dm, base_dc, base_do, miss_pos, inv,
+                       dmatch_cap=delta_match_cap,
+                       dfan_cap=delta_fanout_cap)
+    return DeltaRouteResult(res=r, dp=dp)
+
+
+def _with_delta_compact(dres: DeltaRouteResult, payload_cap: int,
+                        d_payload_cap: int,
+                        match_holes: bool) -> CompactDeltaRouteResult:
+    """Fuse both CSR compactions onto a delta route result. The delta
+    family reuses ops.compact.compact_result with a width-1 all-empty
+    shared family (cs == 0), so offsets/counts3/payload decode with the
+    same csr_slices as the main planes; delta matches are always
+    prefix-compacted (match_holes=False compiles the hole stage away)."""
+    from emqx_tpu.ops.compact import compact_result
+    r, dp = dres.res, dres.dp
+    cp = compact_result(r.matches, r.rows, r.opts, r.fan_counts,
+                        r.shared_sids, r.shared_rows, r.shared_opts,
+                        payload_cap=payload_cap, match_holes=match_holes)
+    W, B = dp.fids.shape[:2]
+    no_slot = jnp.full((W, B, 1), -1, jnp.int32)
+    zero32 = jnp.zeros((W, B, 1), jnp.int32)
+    zero8 = jnp.zeros((W, B, 1), jnp.int8)
+    dcp = compact_result(dp.fids, dp.rows, dp.opts, dp.fan_counts,
+                         no_slot, zero32, zero8,
+                         payload_cap=d_payload_cap, match_holes=False)
+    return CompactDeltaRouteResult(dres=dres, compact=cp, d_compact=dcp)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "delta_match_cap", "delta_fanout_cap",
+                     "payload_cap", "d_payload_cap"))
+def route_step_delta_compact(tables, delta, cursors, topics, lens,
+                             is_dollar, msg_hash, strategy, *,
+                             frontier_cap: int = 16, match_cap: int = 64,
+                             fanout_cap: int = 128, slot_cap: int = 16,
+                             delta_match_cap: int = 16,
+                             delta_fanout_cap: int = 64,
+                             payload_cap: int = 4096,
+                             d_payload_cap: int = 1024
+                             ) -> CompactDeltaRouteResult:
+    """route_step_delta + fused CSR readbacks (both plane families)."""
+    dres = route_step_delta(tables, delta, cursors, topics, lens,
+                            is_dollar, msg_hash, strategy,
+                            frontier_cap=frontier_cap,
+                            match_cap=match_cap, fanout_cap=fanout_cap,
+                            slot_cap=slot_cap,
+                            delta_match_cap=delta_match_cap,
+                            delta_fanout_cap=delta_fanout_cap)
+    return _with_delta_compact(dres, payload_cap, d_payload_cap,
+                               match_holes=False)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout_cap", "slot_cap", "delta_match_cap",
+                     "delta_fanout_cap", "payload_cap", "d_payload_cap"))
+def route_window_delta_compact(tables, delta, cursors, topics, lens,
+                               is_dollar, msg_hash, strategy, *,
+                               fanout_cap: int = 128, slot_cap: int = 16,
+                               delta_match_cap: int = 16,
+                               delta_fanout_cap: int = 64,
+                               payload_cap: int = 4096,
+                               d_payload_cap: int = 1024
+                               ) -> CompactDeltaRouteResult:
+    """route_window_delta + fused CSR readbacks (both plane families)."""
+    dres = route_window_delta(tables, delta, cursors, topics, lens,
+                              is_dollar, msg_hash, strategy,
+                              fanout_cap=fanout_cap, slot_cap=slot_cap,
+                              delta_match_cap=delta_match_cap,
+                              delta_fanout_cap=delta_fanout_cap)
+    return _with_delta_compact(dres, payload_cap, d_payload_cap,
+                               match_holes=True)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("frontier_cap", "match_cap", "fanout_cap",
+                     "slot_cap", "delta_match_cap", "delta_fanout_cap",
+                     "payload_cap", "d_payload_cap"))
+def route_step_delta_cached_compact(tables, delta, cursors, miss_topics,
+                                    miss_lens, miss_dollar, base_matches,
+                                    base_counts, base_overflow, base_dm,
+                                    base_dc, base_do, miss_pos, inv,
+                                    msg_hash, strategy, *,
+                                    frontier_cap: int = 16,
+                                    match_cap: int = 64,
+                                    fanout_cap: int = 128,
+                                    slot_cap: int = 16,
+                                    delta_match_cap: int = 16,
+                                    delta_fanout_cap: int = 64,
+                                    payload_cap: int = 4096,
+                                    d_payload_cap: int = 1024
+                                    ) -> CompactDeltaRouteResult:
+    """Deduplicated trie step + overlay + both CSR readbacks."""
+    dres = route_step_delta_cached(
+        tables, delta, cursors, miss_topics, miss_lens, miss_dollar,
+        base_matches, base_counts, base_overflow, base_dm, base_dc,
+        base_do, miss_pos, inv, msg_hash, strategy,
+        frontier_cap=frontier_cap, match_cap=match_cap,
+        fanout_cap=fanout_cap, slot_cap=slot_cap,
+        delta_match_cap=delta_match_cap,
+        delta_fanout_cap=delta_fanout_cap)
+    return _with_delta_compact(dres, payload_cap, d_payload_cap,
+                               match_holes=False)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout_cap", "slot_cap", "delta_match_cap",
+                     "delta_fanout_cap", "payload_cap", "d_payload_cap"))
+def route_window_delta_cached_compact(tables, delta, cursors,
+                                      miss_topics, miss_lens,
+                                      miss_dollar, base_matches,
+                                      base_counts, base_overflow,
+                                      base_dm, base_dc, base_do,
+                                      miss_pos, inv, msg_hash, strategy,
+                                      *, fanout_cap: int = 128,
+                                      slot_cap: int = 16,
+                                      delta_match_cap: int = 16,
+                                      delta_fanout_cap: int = 64,
+                                      payload_cap: int = 4096,
+                                      d_payload_cap: int = 1024
+                                      ) -> CompactDeltaRouteResult:
+    """Deduplicated window step + overlay + both CSR readbacks."""
+    dres = route_window_delta_cached(
+        tables, delta, cursors, miss_topics, miss_lens, miss_dollar,
+        base_matches, base_counts, base_overflow, base_dm, base_dc,
+        base_do, miss_pos, inv, msg_hash, strategy,
+        fanout_cap=fanout_cap, slot_cap=slot_cap,
+        delta_match_cap=delta_match_cap,
+        delta_fanout_cap=delta_fanout_cap)
+    return _with_delta_compact(dres, payload_cap, d_payload_cap,
+                               match_holes=True)
+
+
 def route_digest(r: RouteResult) -> jax.Array:
     """Scalar int32 reduction over EVERY RouteResult output plane.
 
@@ -375,7 +685,12 @@ def compile_stats() -> dict[str, int]:
     for fn in (route_step, route_step_shapes, route_window_shapes,
                route_window_full, route_step_cached, route_window_cached,
                route_step_compact, route_step_cached_compact,
-               route_window_full_compact, route_window_cached_compact):
+               route_window_full_compact, route_window_cached_compact,
+               route_step_delta, route_window_delta,
+               route_step_delta_cached, route_window_delta_cached,
+               route_step_delta_compact, route_window_delta_compact,
+               route_step_delta_cached_compact,
+               route_window_delta_cached_compact):
         try:
             out[fn.__name__] = fn._cache_size()
         except Exception:  # noqa: BLE001 — cache introspection is best-effort

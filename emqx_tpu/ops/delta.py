@@ -1,0 +1,248 @@
+"""Device-resident delta overlay: post-snapshot filters matched on device.
+
+The compiled trie/shape tables are an immutable snapshot; filters
+subscribed AFTER the build used to live in a host-side `HostTrie` and
+dispatch host-side entirely until the next full rebuild
+(broker/device_engine.py's delta scheme) — so under subscribe churn the
+device path degrades to host speed exactly when the broker is busiest
+(the churn cliff the broker-benchmarking literature keeps measuring;
+PAPERS.md arXiv:1811.07088 §6, arXiv:2603.21600). This op closes that
+hole: the post-snapshot filters live in a SMALL flat overlay table —
+encoded level ids in the same intern word-id space as the main tables,
+plus a per-row fan-out CSR — and a vmapped linear matcher runs them
+against every publish lane inside the SAME fused route program
+(models/router_engine.route_*_delta), so a subscription landing one
+window ago is matched on device in the same dispatch.
+
+A linear matcher (every topic × every overlay row) is the right shape
+here, NOT another NFA: the overlay is bounded by the compaction policy
+to a few hundred rows (pow2 row classes, broker/device_engine.py), so
+the scan is a [B, C] dense op over L levels — trivially vectorizable,
+no frontier state, no hash probes — and the table rebuilds host-side in
+microseconds on every subscribe instead of the O(N) world recapture.
+
+Match semantics are EXACTLY emqx_topic.erl match/2, same as the main
+NFA (ops/match.py) and `HostTrie` (oracle-tested against both):
+
+  - per level: exact word id or '+'; a trailing '#' matches >= 0
+    remaining levels ("sport/# matches sport");
+  - root-'$' exclusion: topics whose first level starts with '$' skip
+    filters whose FIRST level is '+' or '#';
+  - unseen publish words encode to UNKNOWN (ops/intern.py) and can only
+    match wildcards — identical to the main tables by construction.
+
+Emitted matches are overlay ROW indices (prefix-compacted, -1 pad), the
+engine's delta fids ride in `DeltaTables.fids` for host attribution.
+Fan-out expansion reuses ops/fanout._segment_expand over the overlay's
+own CSR; rows are session rows + packed subopts exactly like the main
+`SubTable` planes, so the consume walk is shared.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.ops.fanout import _segment_expand
+from emqx_tpu.ops.intern import HASH, PAD, PLUS
+from emqx_tpu.ops.match import MatchResult
+
+
+class DeltaTables(NamedTuple):
+    """Flat device overlay of post-snapshot filters; a clean JAX pytree.
+
+    levels: [C, L] int32 encoded filter level ids ('+'/'#' as the
+            reserved PLUS/HASH ids, PAD beyond lens[c]).
+    lens:   [C] int32 level counts; 0 = empty row (matches nothing).
+    fids:   [C] int32 engine delta fid per row (-1 = empty) — host
+            attribution only, never compared on device.
+    sub_start/sub_row/sub_opts: per-row fan-out CSR (session rows +
+            packed subopts, the SubTable planes' delta twin). Rows with
+            host-side delivery (rich subopts / oversized fan-out) keep
+            an EMPTY segment — they still match on device.
+    """
+
+    levels: jax.Array      # [C, L]
+    lens: jax.Array        # [C]
+    fids: jax.Array        # [C]
+    sub_start: jax.Array   # [C+1]
+    sub_row: jax.Array     # [S]
+    sub_opts: jax.Array    # [S] int8
+
+
+class DeltaPlanes(NamedTuple):
+    """Per-lane overlay output planes (the delta twin of RouteResult's
+    match + fan-out families; shared subs never ride the overlay — a
+    post-snapshot shared group dispatches host-side via the existing
+    handled-set sweep)."""
+
+    fids: jax.Array        # [N, Dm] delta fids in match order (-1 pad)
+    counts: jax.Array      # [N] true delta match count
+    moverflow: jax.Array   # [N] match-capacity overflow (pre-fan-out)
+    rows: jax.Array        # [N, Dc] fan-out session rows (-1 pad)
+    opts: jax.Array        # [N, Dc] packed subopts
+    fan_counts: jax.Array  # [N] true fan-out entry count
+    overflow: jax.Array    # [N] combined (match | fan-out) overflow
+
+
+def delta_match(dt: DeltaTables, topics: jax.Array, lens: jax.Array,
+                is_dollar: jax.Array, *, match_cap: int) -> MatchResult:
+    """Linear wildcard match of [N] topic lanes against [C] overlay rows.
+
+    Returns a MatchResult whose `matches` are overlay ROW indices
+    (ascending = overlay insertion order), prefix-compacted like the
+    trie NFA's output. Scans the level axis with [N, C] carries (the
+    same time-axis choice as ops/match.match_batch) so peak memory is
+    [N, C], never [N, C, L].
+    """
+    N, L = topics.shape
+    C = dt.levels.shape[0]
+    rows = jnp.arange(N, dtype=jnp.int32)[:, None]
+
+    flen = dt.lens                                          # [C]
+    last = jnp.take_along_axis(
+        dt.levels, jnp.maximum(flen - 1, 0)[:, None], axis=1)[:, 0]
+    last_hash = (flen > 0) & (last == HASH)                 # [C]
+    # prefix to verify level-by-level: everything before the '#'
+    plen = flen - last_hash.astype(jnp.int32)               # [C]
+
+    def step(ok, xs):
+        l, w = xs                                           # w: [N]
+        fw = dt.levels[:, l]                                # [C]
+        lvl_ok = (fw[None, :] == w[:, None]) | (fw == PLUS)[None, :]
+        need = (l < plen)[None, :]
+        return ok & (~need | lvl_ok), None
+
+    ok0 = jnp.ones((N, C), bool)
+    steps = jnp.arange(L, dtype=jnp.int32)
+    ok, _ = jax.lax.scan(step, ok0, (steps, topics.T))
+
+    len_ok = jnp.where(last_hash[None, :],
+                       lens[:, None] >= plen[None, :],
+                       lens[:, None] == flen[None, :])
+    first = dt.levels[:, 0]
+    dollar_skip = is_dollar[:, None] \
+        & ((first == PLUS) | (first == HASH))[None, :]
+    valid = (ok & len_ok & ~dollar_skip
+             & (flen > 0)[None, :] & (dt.fids >= 0)[None, :]
+             & (lens > 0)[:, None])                          # [N, C]
+
+    counts = valid.sum(-1, dtype=jnp.int32)                  # [N]
+    pos = jnp.cumsum(valid, axis=1, dtype=jnp.int32) - 1
+    pos = jnp.where(valid, pos, match_cap)    # out-of-range → dropped
+    out = jnp.full((N, match_cap), -1, jnp.int32)
+    col = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (N, C))
+    out = out.at[rows, pos].set(col, mode="drop")
+    return MatchResult(matches=out, counts=counts,
+                       overflow=counts > match_cap)
+
+
+def delta_expand(dt: DeltaTables, mr: MatchResult, *,
+                 fanout_cap: int) -> DeltaPlanes:
+    """Expand matched overlay rows into fan-out planes + host fids.
+
+    `mr.overflow` must be MATCH-level only (delta_match's output, or a
+    cache-merged base of the same) — the fan-out overflow is recomputed
+    here from the CURRENT table, so a membership change between cache
+    population and dispatch can never resurrect a stale overflow bit.
+    """
+    rows, idx, fan_counts, fan_oflow = _segment_expand(
+        dt.sub_start, dt.sub_row, mr.matches, fanout_cap)
+    opts = jnp.where(idx >= 0, dt.sub_opts[jnp.clip(idx, 0)], jnp.int8(0))
+    safe = jnp.clip(mr.matches, 0, dt.fids.shape[0] - 1)
+    fids = jnp.where(mr.matches >= 0, dt.fids[safe], -1)
+    return DeltaPlanes(fids=fids, counts=mr.counts, moverflow=mr.overflow,
+                       rows=rows, opts=opts, fan_counts=fan_counts,
+                       overflow=mr.overflow | fan_oflow)
+
+
+@functools.partial(jax.jit, static_argnames=("match_cap", "fanout_cap"))
+def delta_overlay(dt: DeltaTables, topics: jax.Array, lens: jax.Array,
+                  is_dollar: jax.Array, *, match_cap: int = 16,
+                  fanout_cap: int = 64) -> DeltaPlanes:
+    """match + expand in one call (the plain-dispatch composition; the
+    cached route programs call the two stages around their base-row
+    merge instead — models/router_engine.route_*_delta*)."""
+    return delta_expand(dt, delta_match(dt, topics, lens, is_dollar,
+                                        match_cap=match_cap),
+                        fanout_cap=fanout_cap)
+
+
+# ---- host-side builder + host-mirror matcher -----------------------------
+
+def build_delta_tables(entries: list, *, row_cap: int, level_cap: int,
+                       fan_per_row: int = 8) -> DeltaTables:
+    """Compile overlay entries into DeltaTables (numpy; device_put by
+    the caller).
+
+    entries: list of (word_ids, fid, fan) where `fan` is a list of
+    (session_row, packed_opts) — pass an EMPTY fan list for rows whose
+    delivery must stay host-side (rich subopts, oversized fan-out).
+    Capacities are static per (row_cap, level_cap, fan_per_row) class:
+    sub rows are `row_cap * fan_per_row` so overlay membership growth
+    never changes the jit signature inside a class.
+    """
+    C, L = row_cap, level_cap
+    S = max(1, C * fan_per_row)
+    levels = np.full((C, L), PAD, np.int32)
+    lens = np.zeros(C, np.int32)
+    fids = np.full(C, -1, np.int32)
+    sub_start = np.zeros(C + 1, np.int32)
+    sub_row = np.full(S, -1, np.int32)
+    sub_opts = np.zeros(S, np.int8)
+    if len(entries) > C:
+        raise ValueError(f"{len(entries)} overlay entries > row cap {C}")
+    off = 0
+    for c, (words, fid, fan) in enumerate(entries):
+        if len(words) > L:
+            raise ValueError(f"overlay filter deeper than {L} levels")
+        levels[c, :len(words)] = words
+        lens[c] = len(words)
+        fids[c] = fid
+        if len(fan) > fan_per_row:
+            raise ValueError(
+                f"{len(fan)} fan rows > per-row budget {fan_per_row}")
+        sub_start[c] = off
+        for sid, opt in fan:
+            sub_row[off] = sid
+            sub_opts[off] = opt
+            off += 1
+    sub_start[len(entries):] = off
+    return DeltaTables(levels=levels, lens=lens, fids=fids,
+                       sub_start=sub_start, sub_row=sub_row,
+                       sub_opts=sub_opts)
+
+
+def np_filter_match(words: list, enc: np.ndarray, lens: np.ndarray,
+                    dollar: np.ndarray) -> np.ndarray:
+    """Host-mirror of delta_match for ONE filter against [N] encoded
+    topics: the delta-aware match-cache invalidation check
+    (broker/match_cache.py drop_where) — a new/deleted overlay filter
+    drops exactly the cached topics it matches, nothing else. Must stay
+    semantics-identical to delta_match above (oracle-tested)."""
+    fl = len(words)
+    if fl == 0:
+        return np.zeros(len(lens), bool)
+    last_hash = words[-1] == HASH
+    plen = fl - (1 if last_hash else 0)
+    ok = lens > 0
+    if last_hash:
+        ok &= lens >= plen
+    else:
+        ok &= lens == fl
+    for l in range(min(plen, enc.shape[1])):
+        if words[l] != PLUS:
+            ok &= enc[:, l] == words[l]
+    if words[0] in (PLUS, HASH):
+        ok &= ~dollar.astype(bool)
+    return ok
+
+
+def empty_delta_tables(row_cap: int, level_cap: int,
+                       fan_per_row: int = 8) -> DeltaTables:
+    return build_delta_tables([], row_cap=row_cap, level_cap=level_cap,
+                              fan_per_row=fan_per_row)

@@ -110,7 +110,8 @@ class ShardedRouteServer:
                  frontier_cap: int = 16, match_cap: int = 64,
                  fanout_cap: int = 128, slot_cap: int = 16,
                  level_cap: int = 16, max_batch: int = 256,
-                 compact_readback: Optional[bool] = None):
+                 compact_readback: Optional[bool] = None,
+                 delta_overlay: Optional[bool] = None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -170,6 +171,23 @@ class ShardedRouteServer:
             from emqx_tpu.broker.device_engine import _ENV_COMPACT
             compact_readback = _ENV_COMPACT
         self.compact_readback = bool(compact_readback)
+
+        # delta overlay knob (ISSUE 4): accepted for config parity with
+        # the single-chip engine, but the mesh's churn path is ALREADY
+        # incremental — a subscription change dirties only its filter's
+        # shard and poll_rebuild recompiles that shard host-side into
+        # the stacked arrays (update_shard) before the next served
+        # batch, i.e. a per-shard compaction with no world recapture.
+        # The fused per-shard overlay (delta rows merged inside
+        # make_sharded_route_step) is the designed next step; until
+        # then stats() reports the mode so bench rows can't mistake the
+        # per-shard rebuild for the single-chip overlay. The PR-2/3
+        # handled-set sweep and per-slot staleness guard in _consume_one
+        # are the churn-correctness invariants either path must keep.
+        if delta_overlay is None:
+            from emqx_tpu.broker.device_engine import _ENV_DELTA
+            delta_overlay = _ENV_DELTA
+        self.delta_overlay = bool(delta_overlay)
         self._payload_mults = (8, 32, 128)
         self._pay_ewma: Optional[float] = None
         self._compact_warm: set[tuple] = set()    # {(Bp, P)}
@@ -1016,6 +1034,10 @@ class ShardedRouteServer:
             # (see prepare_window), not merely cold
             "match_cache": "bypassed",
             "compact_readback": self.compact_readback,
+            # churn handling on the mesh: per-shard incremental rebuild
+            # (see __init__) — not the single-chip fused overlay
+            "delta_overlay": "per-shard-rebuild" if self.delta_overlay
+            else False,
             "payload_ewma": round(self._pay_ewma, 1)
             if self._pay_ewma is not None else None,
         }

@@ -229,11 +229,18 @@ class TestCachePopulationFromCsr:
         with cc._lock:
             comp_rows = dict(cc._rows)
         assert set(comp_rows) == set(dense_rows)
-        for key, (m, c, o) in comp_rows.items():
-            md, cd, od = dense_rows[key]
+        for key, row in comp_rows.items():
+            m, c, o = row[:3]
+            md, cd, od = dense_rows[key][:3]
             assert m.shape == md.shape      # full match width both ways
             np.testing.assert_array_equal(m[m >= 0], md[md >= 0])
             assert (c, o) == (cd, od)
+            # the delta-overlay fields (ISSUE 4) ride the same rows:
+            # topic encoding identical on both populate paths
+            if len(row) > 3:
+                np.testing.assert_array_equal(row[6],
+                                              dense_rows[key][6])
+                assert row[7:] == dense_rows[key][7:]
         assert comp.metrics.val("match_cache.inserts") > 0
 
 

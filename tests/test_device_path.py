@@ -108,6 +108,12 @@ class TestEngineDirect:
 
     def test_rebuild_after_threshold(self, node):
         node.device_engine.rebuild_threshold = 4
+        # delta overlay OFF restores the pre-ISSUE-4 contract under
+        # test here: post-build filters count toward staleness and the
+        # threshold crossing triggers a full rebuild (with the overlay
+        # on they serve on device and never trip the threshold — see
+        # tests/test_delta_overlay.py)
+        node.device_engine.delta_overlay = False
         b = node.broker
         s1 = Sink()
         sid1 = b.register(s1, "c1")

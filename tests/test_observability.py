@@ -485,7 +485,14 @@ class TestCompileAccounting:
                            "route_step_compact",
                            "route_step_cached_compact",
                            "route_window_full_compact",
-                           "route_window_cached_compact"}
+                           "route_window_cached_compact",
+                           "route_step_delta", "route_window_delta",
+                           "route_step_delta_cached",
+                           "route_window_delta_cached",
+                           "route_step_delta_compact",
+                           "route_window_delta_compact",
+                           "route_step_delta_cached_compact",
+                           "route_window_delta_cached_compact"}
         assert all(isinstance(v, int) for v in st.values())
 
 

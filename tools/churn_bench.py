@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Churn microbenchmark: the delta overlay's win (ISSUE 4).
+
+Measures topic-matches/sec through the REAL DeviceRouteEngine serving
+path (route_batch: prepare → dispatch → materialize → finish, including
+the consume stage — churn's cost lives there too) under SUSTAINED
+subscription churn (>= 1 route change per batch window), twice on one
+machine:
+
+  overlay    delta overlay ON (the default engine): post-snapshot
+             filters match + deliver on device, full rebuilds demoted
+             to rare compactions
+  baseline   delta overlay OFF (EMQX_TPU_DELTA_OVERLAY=0 equivalent):
+             the pre-ISSUE-4 behavior — every message pays the host
+             delta-trie walk, the vectorized fast consume stands down,
+             and the engine full-rebuilds (inline, on this path) every
+             `rebuild_threshold` route changes
+
+A third, no-churn pass on the overlay engine records the steady-state
+rate, which must stay within noise of the PR-3 numbers (the overlay is
+free when the overlay is empty). The JSON row carries matches/sec for
+all three, the full-rebuild counts (acceptance: overlay reduced >= 5x),
+and the routing.device.host_delta counters (acceptance: overlay ~ 0,
+with the baseline's non-zero count measuring the hole being closed).
+
+Env knobs: CHURN_FILTERS (5000), CHURN_BATCH (512), CHURN_BATCHES (48),
+CHURN_RATE (4 subscribes/batch), CHURN_LIVE (64 rolling live churn
+subscriptions), CHURN_THRESHOLD (32), CHURN_WARM_PASSES (2).
+
+Run directly or as `python bench.py --churn`.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class _Sink:
+    def deliver(self, topic_filter, msg):
+        return True
+
+
+def _mk_node(overlay: bool, threshold: int):
+    from emqx_tpu.broker.node import Node
+    return Node({"broker": {"delta_overlay": overlay,
+                            "rebuild_threshold": threshold,
+                            "device_fanout_cap": 4,
+                            "device_slot_cap": 2}})
+
+
+def _subscribe_base(node, n_filters: int) -> list:
+    """Built-snapshot filters spread over several shapes (same generator
+    family as tools/skew_bench.py so rates are comparable)."""
+    b = node.broker
+    sid = b.register(_Sink(), "churn-base")
+    filters = []
+    for i in range(n_filters):
+        depth = 3 + (i % 8)
+        mid = i % depth
+        levels = [f"s{i}" if li != mid else "+" for li in range(depth)]
+        levels[0] = f"d{i % 97}"
+        f = "/".join(levels) + f"/t{i}"
+        filters.append(f)
+        b.subscribe(sid, f, {"qos": 0})
+    return filters
+
+
+def _topics_for(filters, rng, batch: int, n_batches: int,
+                churn_frac: float = 0.25):
+    """Per-batch topic lists: mostly built-filter traffic, with a slice
+    reserved for churn topics (filled in per round — the messages the
+    rolling fresh subscriptions must catch)."""
+    def concretize(f):
+        return "/".join(p if p not in ("+", "#") else f"x{i}"
+                        for i, p in enumerate(f.split("/")))
+
+    pool = [concretize(f) for f in filters[:4096]]
+    out = []
+    n_churn = int(batch * churn_frac)
+    for _ in range(n_batches):
+        idx = rng.randint(0, len(pool), batch - n_churn)
+        out.append(([pool[i] for i in idx], n_churn))
+    return out
+
+
+def _run(node, batches, rate: int, label: str):
+    """Route every batch; between batches, subscribe `rate` fresh
+    filters (the sustained churn). Two identical passes: the first
+    warms — route_batch compiles cold program classes IN-PATH by design
+    (the serving pipeline's gate_cold machinery compiles them in the
+    background instead, which a loop-less bench cannot drive), and the
+    churn schedule walks the overlay through its row classes, so pass 1
+    pays every XLA compile the steady state needs — the second is the
+    measurement. The baseline gets the identical two-pass treatment
+    (its full rebuilds recur every `rebuild_threshold` route changes in
+    BOTH passes, so they are measured, not amortized away). Returns
+    (topics/sec, rebuilds, host_delta) over the timed pass."""
+    from emqx_tpu.broker.message import make
+    eng = node.device_engine
+    b = node.broker
+    sid = b.register(_Sink(), f"churn-{label}")
+    eng.rebuild()
+    seq = 0
+    live = []       # rolling window of churn subscriptions (FIFO)
+    window = int(os.environ.get("CHURN_LIVE", 64))
+
+    def one_pass():
+        nonlocal seq
+        total = 0
+        for topics, n_churn in batches:
+            if rate:
+                # rolling churn: subscribe `rate` fresh filters and
+                # unsubscribe the oldest once the live window is full —
+                # the sub+unsub pattern brokers actually see (clients
+                # cycling), not a monotonically growing filter set
+                for _ in range(rate):
+                    f = f"churn/{label}/{seq}/+"
+                    b.subscribe(sid, f, {"qos": 0})
+                    live.append(f)
+                    seq += 1
+                while len(live) > window:
+                    b.unsubscribe(sid, live.pop(0))
+            fresh = [
+                f"churn/{label}/{max(0, seq - 1 - k % max(1, rate))}/z"
+                for k in range(n_churn)] if rate else \
+                [topics[k % len(topics)] for k in range(n_churn)]
+            msgs = [make("p", 0, t, b"x") for t in topics + fresh]
+            counts = eng.route_batch(msgs)
+            assert counts is not None
+            if rate:
+                # every fresh-subscription topic must have been
+                # delivered — the correctness floor under churn
+                assert all(c >= 1 for c in counts[len(topics):]), label
+            total += len(msgs)
+        return total
+
+    # two warm passes: the first compiles the base + small overlay
+    # classes, the second walks the overlay far enough up its row-class
+    # ladder that the timed pass's crossings land on already-compiled
+    # classes (jit cache hits) instead of multi-second inline traces
+    for _ in range(int(os.environ.get("CHURN_WARM_PASSES", 2))):
+        one_pass()
+    r0 = node.metrics.val("routing.device.rebuilds")
+    h0 = node.metrics.val("routing.device.host_delta")
+    t0 = time.perf_counter()
+    total = one_pass()
+    dt = time.perf_counter() - t0
+    rebuilds = node.metrics.val("routing.device.rebuilds") - r0
+    host_delta = node.metrics.val("routing.device.host_delta") - h0
+    log(f"{label}: {total} topics in {dt:.3f}s "
+        f"({total / dt / 1e3:.1f}k matches/s, {rebuilds} rebuilds, "
+        f"host_delta={host_delta})")
+    return total / dt, rebuilds, host_delta
+
+
+def run_churn() -> dict:
+    n_filters = int(os.environ.get("CHURN_FILTERS", 5000))
+    batch = int(os.environ.get("CHURN_BATCH", 512))
+    n_batches = int(os.environ.get("CHURN_BATCHES", 48))
+    rate = int(os.environ.get("CHURN_RATE", 4))
+    threshold = int(os.environ.get("CHURN_THRESHOLD", 32))
+
+    rng = np.random.RandomState(13)
+    overlay = _mk_node(True, threshold)
+    baseline = _mk_node(False, threshold)
+    assert overlay.device_engine.delta_overlay
+    assert not baseline.device_engine.delta_overlay
+    filters = _subscribe_base(overlay, n_filters)
+    _subscribe_base(baseline, n_filters)
+    log(f"churn bench: {n_filters} filters, {n_batches} batches of "
+        f"{batch}, {rate} subscribes/batch, threshold {threshold}, "
+        f"backend={overlay.device_engine.stats()['backend'] or 'unbuilt'}")
+    batches = _topics_for(filters, rng, batch, n_batches)
+
+    base_ps, base_rb, base_hd = _run(baseline, batches, rate, "baseline")
+    over_ps, over_rb, over_hd = _run(overlay, batches, rate, "overlay")
+    # overlay telemetry BEFORE the steady pass: its rebuild() folds the
+    # delta set into a fresh snapshot and resets the overlay to None
+    overlay_stats = overlay.device_engine.stats()["overlay"]
+    # steady state: same engine, churn already absorbed, no new churn
+    steady_ps, _srb, _shd = _run(overlay, batches, 0, "steady")
+
+    snap = overlay.pipeline_telemetry.snapshot()
+    out = {
+        "metric": "churn_topic_matches_per_sec",
+        "unit": "topic-matches/s",
+        "overlay_per_s": round(over_ps),
+        "baseline_per_s": round(base_ps),
+        "speedup": round(over_ps / base_ps, 2),
+        # full-rebuild pressure: the baseline recompiles the world at
+        # the threshold; the overlay compacts rarely (acceptance >= 5x
+        # fewer — 0 rebuilds in-window reports as the batch count floor)
+        "rebuilds_overlay": over_rb,
+        "rebuilds_baseline": base_rb,
+        "rebuild_reduction": round(base_rb / max(1, over_rb), 2),
+        "host_delta_overlay": over_hd,      # acceptance: ~= 0
+        "host_delta_baseline": base_hd,     # the hole being closed
+        "steady_per_s": round(steady_ps),
+        "workload": {
+            "filters": n_filters, "batch": batch, "batches": n_batches,
+            "churn_rate": rate, "rebuild_threshold": threshold,
+        },
+        "backend": overlay.device_engine.stats()["backend"],
+        "overlay": overlay_stats,
+        "rebuild": snap.get("rebuild"),
+    }
+    return out
+
+
+def main():
+    print(json.dumps(run_churn()), flush=True)
+
+
+if __name__ == "__main__":
+    main()
