@@ -27,7 +27,10 @@ Env knobs: BENCH_SUBS (default 10_000_000), BENCH_BATCH (131072),
 BENCH_WINDOW (32), BENCH_SHARED_PCT (50), BENCH_PUT_CHUNK_MB (64),
 EMQX_TPU_RELAY_WAIT_S (dead-relay fail-fast window, default
 BENCH_INIT_TIMEOUT_S=600 — set it low to stop burning a round's budget
-polling a relay that never comes up).
+polling a relay that never comes up; a PROVABLY dead port now skips the
+poll entirely via the relay_watcher preflight, BENCH_RELAY_PREFLIGHT=0
+restores the wait), BENCH_FANOUT (=0 skips the delivery-lane fan-out
+row; tools/fanout_bench.py knobs FANOUT_*).
 
 Diagnosability: every e2e phase snapshots the node's pipeline telemetry
 (stage timings, batch occupancy, compile counts —
@@ -1337,6 +1340,17 @@ def main():
         churn_bench.main()
         return
 
+    if "--fanout" in sys.argv:
+        # high fan-out delivery microbenchmark for the delivery lanes
+        # (ISSUE 5 acceptance: deliver_lanes=4 >= 2x the inline
+        # baseline at fan-out >= 64, per-session order bit-identical);
+        # full harness lives in tools/fanout_bench.py
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import fanout_bench
+        fanout_bench.main()
+        return
+
     # watchdog: if anything hangs (axon backend init / a stuck transfer),
     # still emit the JSON line before the driver's kill timeout hits
     import signal
@@ -1378,6 +1392,30 @@ def main():
             return any(":808" in ln for ln in r.stdout.splitlines())
         except Exception:  # noqa: BLE001 — treat as unknown, probe anyway
             return True
+
+    if axon and os.environ.get("BENCH_RELAY_PREFLIGHT", "1") != "0":
+        # preflight (ISSUE 5 satellite): a PROVABLY dead relay port must
+        # fail fast with the phase-0-style error JSON (telemetry/
+        # last_measured attached by _error_json) instead of polling out
+        # the whole EMQX_TPU_RELAY_WAIT_S window — BENCH_r05 burned 540s
+        # doing exactly that to report value=0. One probe, through the
+        # watcher's exact-port matcher (tools/relay_watcher.py owns the
+        # mid-round windows now; a round-end bench with no listener is
+        # a dead round, not a window about to open). ss failing to run
+        # reads as "unknown": fall through to the polling loop.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        try:
+            from relay_watcher import relay_listening as _rw_listening
+        except Exception:  # noqa: BLE001 — preflight is best-effort
+            _rw_listening = None
+        if _rw_listening is not None and not _rw_listening():
+            print(_error_json(
+                "relay port provably dead at start (no listener on "
+                ":8082-:809x); skipped the EMQX_TPU_RELAY_WAIT_S poll "
+                f"({init_budget}s) — set BENCH_RELAY_PREFLIGHT=0 to "
+                "wait for a window instead"), flush=True)
+            os._exit(2)
 
     ok, detail = False, "relay never came up"
     while time.time() < deadline:
@@ -1629,6 +1667,39 @@ def main():
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log(f"churn bench failed: {type(e).__name__}: {e}")
                     result["churn_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if os.environ.get("BENCH_FANOUT", "1") != "0":
+                # high fan-out delivery microbench (ISSUE 5): lanes
+                # 0/1/2/4 deliveries/sec + the ordering oracle, CPU
+                # subprocess like the skew/churn rows
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    sp = subprocess.run(
+                        [sys.executable,
+                         os.path.join(os.path.dirname(
+                             os.path.abspath(__file__)),
+                             "tools", "fanout_bench.py")],
+                        capture_output=True, text=True, env=senv,
+                        timeout=int(os.environ.get(
+                            "BENCH_FANOUT_TIMEOUT_S", 600)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        # keep the row compact: the deliver section's
+                        # counters are the interesting slice
+                        row.pop("deliver", None)
+                        result["fanout"] = row
+                    else:
+                        result["fanout_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"fanout bench failed: {type(e).__name__}: {e}")
+                    result["fanout_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             print(json.dumps(result), flush=True)
             return

@@ -72,8 +72,9 @@ class SysBroker:
         (`pipeline/occupancy/<class>`), plus `pipeline/compiles`,
         `pipeline/decisions` and — when the relevant layer has traffic —
         `pipeline/match_cache` / `pipeline/dedup` / `pipeline/readback`
-        / `pipeline/rebuild`
-        (dense-vs-compact device→host transfer bytes, ISSUE 3)."""
+        (dense-vs-compact device→host transfer bytes, ISSUE 3) /
+        `pipeline/rebuild` / `pipeline/deliver` (delivery-lane egress
+        stage, ISSUE 5)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -88,7 +89,8 @@ class SysBroker:
                   json.dumps(snap["compiles"]).encode())
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
-        for section in ("match_cache", "dedup", "readback", "rebuild"):
+        for section in ("match_cache", "dedup", "readback", "rebuild",
+                        "deliver"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

@@ -416,6 +416,15 @@ class PublishBatcher:
                 raise entry["error"]
             live, live_idx = entry["live"], entry["live_idx"]
             if routed is None and live:
+                # deliver lanes first (ISSUE 5): a host-routed batch
+                # delivers inline on the loop, so it must wait out any
+                # lane-queued device deliveries — otherwise a host batch
+                # could overtake an earlier device batch for the same
+                # session and break the per-publisher FIFO this
+                # consumer exists to preserve
+                pool = getattr(self.node, "deliver_lanes", None)
+                if pool is not None and pool.busy():
+                    await pool.drain()
                 t0 = time.perf_counter()
                 routed = []
                 broker = self.node.broker
@@ -443,21 +452,36 @@ class PublishBatcher:
                 # the next device sample must be a full round-trip, not
                 # completion-to-completion across this host batch
                 self._last_dev_done = None
-            if live:
-                for j, i in enumerate(live_idx):
-                    counts[i] = routed[j]
-            for i, (_m, fut) in enumerate(batch):
-                if fut is not None and not fut.done():
-                    fut.set_result(counts[i])
-            # PUBLISH→route latency sample: oldest enqueue → completion
-            # (covers both host- and device-routed entries — the device
-            # path funnels through here with `routed` precomputed)
-            t_enq = entry.get("t_enq")
-            if t_enq is not None:
-                total = time.perf_counter() - t_enq
-                self.route_lat.append(total)
-                if tele is not None:
-                    tele.record_total(total, batch=len(batch), path=path)
+            def _settle() -> None:
+                if live:
+                    for j, i in enumerate(live_idx):
+                        counts[i] = routed[j]
+                for i, (_m, fut) in enumerate(batch):
+                    if fut is not None and not fut.done():
+                        fut.set_result(counts[i])
+                # PUBLISH→route latency sample: oldest enqueue →
+                # completion (covers both host- and device-routed
+                # entries — the device path funnels through here with
+                # `routed` precomputed)
+                t_enq = entry.get("t_enq")
+                if t_enq is not None:
+                    total = time.perf_counter() - t_enq
+                    self.route_lat.append(total)
+                    if tele is not None:
+                        tele.record_total(total, batch=len(batch),
+                                          path=path)
+
+            # deliver-lane hand-off (ISSUE 5): a LaneCounts carries the
+            # in-flight DeliveryPlan — publisher futures resolve when
+            # the lanes finish delivering (counts are placeholders
+            # until then), while THIS consumer moves on to the next
+            # window. That is the overlap the egress stage buys; the
+            # completion chain itself stays FIFO via the lane queues.
+            plan = getattr(routed, "plan", None)
+            if plan is not None and not plan.done:
+                plan.add_done_callback(_settle)
+            else:
+                _settle()
         except Exception as e:  # route failure must not hang publishers
             for _m, fut in batch:
                 if fut is not None and not fut.done():
@@ -511,6 +535,14 @@ class PublishBatcher:
             # the window's dispatching entry failed/abandoned earlier
             return None
         counts = self.engine.finish_sub(handle, sub)
+        pool = getattr(self.node, "deliver_lanes", None)
+        if pool is not None and pool.active():
+            # backpressure: too many plans queued in the delivery lanes
+            # stalls THIS consumer, which fills _inflight, which blocks
+            # the producer's put, which bounces submit()/enqueue() —
+            # a blocked lane therefore stalls publishers instead of
+            # buffering (or dropping) deliveries unboundedly
+            await pool.admit()
         done = time.perf_counter()
         if sub == n_subs - 1:
             # ONE cost sample per WINDOW, divided by its width — sampling

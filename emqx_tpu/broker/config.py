@@ -54,6 +54,14 @@ DEFAULTS: dict = {
         # would silently shadow the env knob through the defaults merge.
         "rebuild_threshold": None,
         "device_min_batch": 4,
+        # None = resolve via EMQX_TPU_DELIVER_LANES, then min(4, cpus)
+        # (broker/deliver.resolve_deliver_lanes); 0 restores the inline
+        # delivery loop exactly (the ISSUE-5 A/B baseline). A baked-in
+        # number here would shadow the env knob through the merge.
+        "deliver_lanes": None,
+        # max outstanding delivery plans before the batcher's consumer
+        # blocks (backpressure up through _inflight to submit/enqueue)
+        "deliver_lane_depth": 8,
         "perf": {"trie_compaction": True},
     },
     "zones": {},                 # zone name -> {mqtt: {...}} overrides

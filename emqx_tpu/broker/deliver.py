@@ -1,0 +1,872 @@
+"""Parallel fan-out delivery lanes: the session-affine egress stage.
+
+ISSUE 5 tentpole. PR 2-4 made match, readback and churn device-fast,
+but every delivery still funneled through one serial Python loop on the
+consume side (`DeviceRouteEngine._fast_deliver` row-by-row into
+`Broker._deliver`), with a `msg.copy()` + headers-dict mutation + hook
+dispatch per subscriber — at the north-star fan-out (deliveries/s >>
+matches/s) egress was the hard ceiling, and it blocked the next
+window's finish. This module turns deliver into its own overlapped
+pipeline stage:
+
+- **DeliveryPlan**: the vectorized delivery plan of one consumed
+  sub-batch. The engine's row-attribution gather already produces
+  `(row_msg, sid, opt, fid)` arrays; the plan buckets them by
+  `sid % n_lanes` with ONE stable argsort pass (secondary key `sid`, so
+  same-session deliveries are contiguous for coalescing) and hands each
+  lane a contiguous slice. A session always hashes to the same lane,
+  so per-session FIFO — the MQTT ordering invariant — holds by
+  construction. Slow-path messages (shared groups, rich subopts,
+  delta-matched, dirty filters, host fallbacks) ride the SAME plan as
+  ordered closures behind an all-lanes barrier: every lane finishes its
+  fast slices first, exactly one worker runs the slow closures in batch
+  order, and no lane proceeds past the barrier meanwhile — the
+  per-session interleaving is bit-identical to the inline loop
+  (fast rows first, then slow rows, per window).
+
+- **DeliveryLanePool**: a small pool of asyncio lane workers (config
+  `broker.deliver_lanes` / env `EMQX_TPU_DELIVER_LANES`, default
+  `min(4, cpus)`; `=0` restores the inline loop exactly — the A/B
+  baseline) consuming per-lane queues. The batcher's consume stage
+  submits the plan and returns, so delivery overlaps the next window's
+  dispatch/materialize (which run on executor threads and release the
+  GIL in XLA / the relay HTTP client); `admit()` bounds outstanding
+  plans and propagates backpressure to the batcher's `_inflight` queue,
+  and `drain()` serializes host-routed batches behind in-flight lane
+  work so device/host interleaving cannot reorder a session's stream.
+
+- **DeliveryView**: the copy-on-write per-delivery message. Replaces
+  the per-subscriber `msg.copy()` + `headers["subopts"]` mutation with
+  one small object sharing the frozen payload/topic/headers of the
+  routed message and overlaying `subopts`; the first write (set_header
+  / set_flag / update_expiry) materializes private dicts, and `copy()`
+  yields a real, independent `Message` — so downstream enrichment
+  (session._enrich) is untouched. Metric/hook bookkeeping
+  (`messages.delivered`, `message.delivered`) is batched per lane
+  slice instead of per row; same-session runs within a slice coalesce
+  into one `deliver_batch()` call (one session accept + one socket
+  drain) when the subscriber supports it.
+
+Ordering contract (what the property tests pin): for every session,
+the delivered sequence under `deliver_lanes=N` is identical to the
+inline `deliver_lanes=0` sequence. Within a window the inline order is
+"all fast rows, then slow messages in batch order"; lanes reproduce it
+with the slice-then-barrier queueing above, and windows serialize
+per-lane because plans enqueue in consume (FIFO) order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+log = logging.getLogger("emqx.deliver")
+
+# a fast-path message whose deliveries were handed to the lanes: the
+# consume loop must not treat it as "needs the slow path" (None) nor as
+# a settled count (int) — the plan's finalize writes the real count
+DEFERRED = object()
+
+
+def _unpack_opts(b: int) -> dict:
+    return {"qos": b & 0x3, "nl": (b >> 2) & 1, "rap": (b >> 3) & 1,
+            "rh": (b >> 4) & 0x3}
+
+
+# The packed subopts word is 6 bits (qos:2 | nl:1 | rap:1 | rh:2), so
+# there are exactly 64 distinct unpacked dicts — precompute them all
+# once instead of re-unpacking (and re-dict-copying) per delivery.
+# CONTRACT: these dicts are FROZEN — every consumer treats delivered
+# subopts as read-only (session._enrich only reads; dispatch paths that
+# need to extend them build a new dict, e.g. dict(opts, share=g)).
+OPT_TABLE = tuple(_unpack_opts(b) for b in range(64))
+
+
+def resolve_deliver_lanes(configured=None) -> int:
+    """The one deliver-lanes resolution: config beats
+    EMQX_TPU_DELIVER_LANES beats the built-in min(4, cpus). 0 disables
+    the lanes (the inline-loop A/B baseline); negatives are a
+    deployment error worth failing loudly on."""
+    if configured is not None:
+        val = int(configured)
+    else:
+        env = os.environ.get("EMQX_TPU_DELIVER_LANES")
+        if env is None:
+            return min(4, os.cpu_count() or 1)
+        try:
+            val = int(env)
+        except ValueError:
+            raise ValueError(
+                f"EMQX_TPU_DELIVER_LANES={env!r} is not an integer")
+    if val < 0:
+        raise ValueError(f"deliver_lanes must be >= 0, got {val}")
+    return val
+
+
+class _ViewHeaders:
+    """Read-through headers mapping of a DeliveryView: the base
+    message's headers with `subopts` overlaid, no dict built. Writing
+    through it materializes the view's private headers dict first
+    (copy-on-write)."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, view: "DeliveryView"):
+        self._v = view
+
+    def _own(self):
+        h = self._v._headers
+        return h if h is not None else None
+
+    def get(self, key, default=None):
+        h = self._v._headers
+        if h is not None:
+            return h.get(key, default)
+        if key == "subopts":
+            return self._v._subopts
+        return self._v._base_headers.get(key, default)
+
+    def __getitem__(self, key):
+        h = self._v._headers
+        if h is not None:
+            return h[key]
+        if key == "subopts":
+            return self._v._subopts
+        return self._v._base_headers[key]
+
+    def __contains__(self, key):
+        h = self._v._headers
+        if h is not None:
+            return key in h
+        return key == "subopts" or key in self._v._base_headers
+
+    def __setitem__(self, key, val):
+        self._v._materialize_headers()[key] = val
+
+    def pop(self, key, *a):
+        return self._v._materialize_headers().pop(key, *a)
+
+    def setdefault(self, key, default=None):
+        return self._v._materialize_headers().setdefault(key, default)
+
+    def update(self, *a, **kw):
+        self._v._materialize_headers().update(*a, **kw)
+
+    def __delitem__(self, key):
+        del self._v._materialize_headers()[key]
+
+    def popitem(self):
+        return self._v._materialize_headers().popitem()
+
+    def clear(self):
+        self._v._materialize_headers().clear()
+
+    def _as_dict(self) -> dict:
+        h = self._v._headers
+        if h is not None:
+            return dict(h)
+        d = dict(self._v._base_headers)
+        d["subopts"] = self._v._subopts
+        return d
+
+    def items(self):
+        return self._as_dict().items()
+
+    def keys(self):
+        return self._as_dict().keys()
+
+    def values(self):
+        return self._as_dict().values()
+
+    def copy(self) -> dict:
+        return self._as_dict()
+
+    def __iter__(self):
+        return iter(self._as_dict())
+
+    def __len__(self):
+        return len(self._as_dict())
+
+    def __eq__(self, other):
+        if isinstance(other, _ViewHeaders):
+            other = other._as_dict()
+        return self._as_dict() == other
+
+    def __repr__(self):
+        return repr(self._as_dict())
+
+
+class DeliveryView:
+    """Copy-on-write per-delivery message: shares the routed message's
+    payload/topic/flags/headers and overlays `subopts` — the lightweight
+    replacement for `msg.copy()` + `headers["subopts"] = subopts` on
+    the lane fast path. Message-API compatible: reads delegate, the
+    first write materializes a private dict, `copy()` returns a real
+    independent Message (so session._enrich keeps working unchanged).
+
+    Copy-on-write boundary: mutations through the Message API
+    (set_flag / set_header / headers[...] / update_expiry) are
+    isolated; the `flags` and `extra` dicts read through to the BASE
+    message until a set_flag materializes — a consumer that mutates
+    `msg.flags`/`msg.extra` by direct dict access would write the
+    routed message every subscriber shares. No in-repo consumer does
+    (session enrichment copies first; hooks read), and delivered
+    messages are read-only by the Subscriber protocol contract
+    (pubsub.py) — `copy()` first if you must mutate beyond the API."""
+
+    __slots__ = ("topic", "payload", "qos", "from_", "id", "ts", "extra",
+                 "_base_flags", "_base_headers", "_subopts", "_flags",
+                 "_headers")
+
+    def __init__(self, msg, subopts: dict):
+        self.topic = msg.topic
+        self.payload = msg.payload
+        self.qos = msg.qos
+        self.from_ = msg.from_
+        self.id = msg.id
+        self.ts = msg.ts
+        self.extra = msg.extra
+        self._base_flags = msg.flags
+        self._base_headers = msg.headers
+        self._subopts = subopts
+        self._flags = None
+        self._headers = None
+
+    # -- copy-on-write materialization --
+    def _materialize_headers(self) -> dict:
+        if self._headers is None:
+            h = dict(self._base_headers)
+            h["subopts"] = self._subopts
+            self._headers = h
+        return self._headers
+
+    def _materialize_flags(self) -> dict:
+        if self._flags is None:
+            self._flags = dict(self._base_flags)
+        return self._flags
+
+    @property
+    def headers(self):
+        if self._headers is not None:
+            return self._headers
+        return _ViewHeaders(self)
+
+    @property
+    def flags(self):
+        return self._flags if self._flags is not None else self._base_flags
+
+    # -- Message API parity (emqx_tpu.broker.message.Message) --
+    def get_flag(self, name: str, default: bool = False) -> bool:
+        return bool(self.flags.get(name, default))
+
+    def set_flag(self, name: str, val: bool = True) -> "DeliveryView":
+        self._materialize_flags()[name] = val
+        return self
+
+    @property
+    def retain(self) -> bool:
+        return self.get_flag("retain")
+
+    @property
+    def dup(self) -> bool:
+        return self.get_flag("dup")
+
+    @property
+    def is_sys(self) -> bool:
+        return self.get_flag("sys") or self.topic.startswith("$SYS/")
+
+    def get_header(self, name: str, default=None):
+        if self._headers is not None:
+            return self._headers.get(name, default)
+        if name == "subopts":
+            return self._subopts
+        return self._base_headers.get(name, default)
+
+    def set_header(self, name: str, val) -> "DeliveryView":
+        self._materialize_headers()[name] = val
+        return self
+
+    def expiry_interval(self) -> Optional[int]:
+        props = self.get_header("properties") or {}
+        return props.get("message_expiry_interval")
+
+    def is_expired(self) -> bool:
+        from emqx_tpu.broker.message import now_ms
+        exp = self.expiry_interval()
+        if exp is None:
+            return False
+        return now_ms() > self.ts + exp * 1000
+
+    def update_expiry(self) -> "DeliveryView":
+        from emqx_tpu.broker.message import now_ms
+        exp = self.expiry_interval()
+        if exp is not None:
+            remaining = max(1, exp - (now_ms() - self.ts) // 1000)
+            props = dict(self.get_header("properties") or {})
+            props["message_expiry_interval"] = int(remaining)
+            self.set_header("properties", props)
+        return self
+
+    def copy(self):
+        from emqx_tpu.broker.message import Message
+        if self._headers is not None:
+            headers = dict(self._headers)
+        else:
+            headers = dict(self._base_headers)
+            headers["subopts"] = self._subopts
+        return Message(topic=self.topic, payload=self.payload,
+                       qos=self.qos, from_=self.from_,
+                       flags=dict(self.flags), headers=headers,
+                       id=self.id, ts=self.ts, extra=dict(self.extra))
+
+    def to_map(self) -> dict:
+        from emqx_tpu.broker.message import base62_encode
+        return {
+            "id": base62_encode(self.id), "topic": self.topic,
+            "qos": self.qos, "from": self.from_,
+            "payload": self.payload, "flags": dict(self.flags),
+            "timestamp": self.ts, "retain": self.retain,
+        }
+
+    def to_wire(self) -> dict:
+        return self.copy().to_wire()
+
+    def __repr__(self):
+        return (f"DeliveryView(topic={self.topic!r}, qos={self.qos}, "
+                f"from_={self.from_!r})")
+
+
+class DeliveryPlan:
+    """One consumed sub-batch's delivery work: fast rows destined for
+    the lanes plus slow-path closures behind the barrier. `counts[i]`
+    accumulates message i's successful deliveries; `target` (the
+    LaneCounts list the engine returned to the batcher) is back-filled
+    at finalize, and done-callbacks fire last (publisher futures,
+    handle release)."""
+
+    __slots__ = ("pool", "msgs", "counts", "fast_idx", "slow_items",
+                 "filters", "_chunks", "routed_device", "pending",
+                 "done", "target", "_cbs", "s_midx", "s_sid", "s_opt",
+                 "s_fid", "_barrier_left", "_barrier_evt")
+
+    def __init__(self, pool: "DeliveryLanePool", msgs: list):
+        self.pool = pool
+        self.msgs = msgs
+        self.counts = np.zeros(len(msgs), np.int64)
+        self.fast_idx: list[int] = []
+        self.slow_items: list[tuple[int, Callable[[], int]]] = []
+        self.filters = None         # fid -> topic-filter string
+        self._chunks: list[tuple] = []
+        self.routed_device = False
+        self.pending = 0            # outstanding lane parts
+        self.done = False
+        self.target = None          # LaneCounts to back-fill
+        self._cbs: list[Callable[[], None]] = []
+        self.s_midx = self.s_sid = self.s_opt = self.s_fid = None
+        self._barrier_left = 0
+        self._barrier_evt: Optional[asyncio.Event] = None
+
+    # -- building (engine consume stage, event loop) --
+    def register_fast(self, indices) -> None:
+        """Mark message indices whose deliveries the lanes own (their
+        no-subscriber drop bookkeeping moves to finalize)."""
+        self.fast_idx.extend(int(i) for i in indices)
+
+    def add_rows(self, midx, sid, opt, fid, filters) -> None:
+        """One vectorized chunk of fast deliveries: parallel arrays of
+        (message index, session id, packed opts, filter id) plus the
+        fid -> filter-string table they index (the pinned snapshot's
+        `fid_filter` for the single-chip engine; a plan-local list for
+        the mesh)."""
+        if self.filters is None:
+            self.filters = filters
+        elif self.filters is not filters:
+            # shouldn't happen (one snapshot per plan) — remap defensively
+            base = len(self.filters)
+            self.filters = list(self.filters) + list(filters)
+            fid = np.asarray(fid) + base
+        self._chunks.append((np.asarray(midx, np.int64),
+                             np.asarray(sid, np.int64),
+                             np.asarray(opt, np.int64),
+                             np.asarray(fid, np.int64)))
+
+    def add_rows_py(self, msg_idx: int, rows: list[tuple]) -> None:
+        """Python-built fast rows for one message (mesh consume):
+        `rows` is [(sid, packed_opt, filter_string)]. Appends to a
+        plan-local filter table."""
+        if not rows:
+            return
+        if self.filters is None:
+            self.filters = []
+        base = len(self.filters)
+        n = len(rows)
+        midx = np.full(n, msg_idx, np.int64)
+        sid = np.fromiter((r[0] for r in rows), np.int64, n)
+        opt = np.fromiter((r[1] for r in rows), np.int64, n)
+        fidx = np.arange(base, base + n, dtype=np.int64)
+        self.filters.extend(r[2] for r in rows)
+        self._chunks.append((midx, sid, opt, fidx))
+
+    def add_slow(self, msg_idx: int, fn: Callable[[], int]) -> None:
+        """A message the fast path cannot prove clean: `fn` runs the
+        ordering-safe inline consume for it (behind the barrier) and
+        returns its delivery count."""
+        self.slow_items.append((msg_idx, fn))
+
+    def add_done_callback(self, cb: Callable[[], None]) -> None:
+        if self.done:
+            cb()
+        else:
+            self._cbs.append(cb)
+
+    # -- completion (lane workers, event loop) --
+    def _finish_part(self) -> None:
+        self.pending -= 1
+        if self.pending <= 0 and not self.done:
+            self._finalize()
+
+    def _finalize(self) -> None:
+        self.done = True
+        pool = self.pool
+        if self.target is not None:
+            counts = self.counts
+            for i in range(len(self.msgs)):
+                self.target[i] = int(counts[i])
+        # no-subscriber bookkeeping for lane-owned messages (the slow
+        # closures did their own inside the inline consume)
+        metrics = pool.metrics
+        hooks = pool.hooks
+        for i in self.fast_idx:
+            if self.counts[i] == 0 and not self.msgs[i].is_sys:
+                metrics.inc("messages.dropped")
+                metrics.inc("messages.dropped.no_subscribers")
+                if hooks is not None:
+                    hooks.run("message.dropped",
+                              (self.msgs[i], "no_subscribers"))
+        for cb in self._cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — one waiter must not
+                log.exception("delivery-plan callback failed")  # stall
+        self._cbs = []
+        pool._plan_done(self)
+
+
+class LaneCounts(list):
+    """finish_sub's return value when the lanes own the deliveries: a
+    plain list of per-message counts (placeholders until the plan
+    completes) carrying the plan so the batcher can defer publisher
+    futures with `plan.add_done_callback`."""
+
+    plan: DeliveryPlan
+
+
+_PARK = ("park",)
+
+
+class DeliveryLanePool:
+    """N session-affine delivery lanes on the event loop.
+
+    Why asyncio tasks and not threads: every subscriber callback
+    (channel -> session -> asyncio transport write) is loop-affine, so
+    thread workers would need a lock per session; loop tasks keep the
+    single-writer discipline for free, and the OVERLAP the stage buys
+    is with the device dispatch/materialize stages, which run on
+    executor threads and release the GIL inside XLA / the relay HTTP
+    round trip. The lanes also amortize per-row Python: one view object
+    instead of a Message copy, coalesced same-session drains, and
+    per-slice (not per-row) metric/hook bookkeeping.
+    """
+
+    def __init__(self, broker, metrics, *, hooks=None, telemetry=None,
+                 n_lanes: int = 4, depth: int = 8):
+        self.broker = broker
+        self.metrics = metrics
+        self.hooks = hooks
+        self.telemetry = telemetry
+        self.n_lanes = n_lanes
+        # max outstanding PLANS (consumed sub-batches) before admit()
+        # blocks the batcher's consumer — the backpressure bound
+        self.depth = max(1, depth)
+        self._loop = None
+        self._queues: list[asyncio.Queue] = []
+        self._workers: list[Optional[asyncio.Task]] = []
+        self._wake: Optional[asyncio.Event] = None
+        self._gate: Optional[asyncio.Event] = None
+        self._paused = False
+        self._live_plans = 0
+        self._plans: list[DeliveryPlan] = []     # in-flight, FIFO
+        self._lane_items: list[int] = [0] * n_lanes  # real work per lane
+        # same-sid coalescing yields one drain per run; chunk big slices
+        # so one huge fan-out cannot monopolize the loop between yields.
+        # 2048 rows ≈ 1-2ms of delivery per burst — well under the
+        # pipeline's loop-stall budget — while finer chunks measurably
+        # thrash (sweep on a 2-cpu box: 512→310k, 2048→556k, 8192→378k
+        # deliveries/s at lanes=4: too-fine interleaving rotates lanes'
+        # working sets through cache per yield)
+        self._chunk = 2048
+
+    # ---- lifecycle ------------------------------------------------------
+    def active(self) -> bool:
+        return self.n_lanes > 0
+
+    def ensure_loop(self) -> bool:
+        """(Re)start the workers on the CURRENT running loop. Tests run
+        several event loops against one Node; workers from a dead loop
+        are discarded and fresh queues built — plans never span loops
+        (drain() runs before a loop winds down in every serving path)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        if loop is not self._loop:
+            orphans = [p for p in self._plans if not p.done]
+            self._plans = []
+            self._loop = loop
+            self._queues = [asyncio.Queue() for _ in range(self.n_lanes)]
+            self._workers = [None] * self.n_lanes
+            self._wake = asyncio.Event()
+            self._gate = asyncio.Event()
+            if not self._paused:
+                self._gate.set()
+            self._lane_items = [0] * self.n_lanes
+            # plans stranded by a torn-down loop (tests run several
+            # loops against one node) must still finalize: their
+            # callbacks release pinned snapshot handles — leaking one
+            # would block every future swap on this engine
+            self._live_plans = len(orphans)
+            for p in orphans:
+                p.pending = 0
+                p._finalize()
+        for i in range(self.n_lanes):
+            w = self._workers[i]
+            if w is None or w.done():
+                self._workers[i] = loop.create_task(self._worker(i))
+        return True
+
+    def pause(self) -> None:
+        """Quiesce the lanes (tests, shutdown drains): queued plans stay
+        queued; resume() releases them."""
+        self._paused = True
+        if self._gate is not None:
+            self._gate.clear()
+
+    def resume(self) -> None:
+        self._paused = False
+        if self._gate is not None:
+            self._gate.set()
+
+    # ---- plan intake (engine consume stage) -----------------------------
+    def new_plan(self, msgs: list) -> Optional[DeliveryPlan]:
+        if not self.active() or not self.ensure_loop():
+            return None
+        return DeliveryPlan(self, msgs)
+
+    def submit(self, plan: DeliveryPlan) -> None:
+        """Bucket the plan's fast rows into session-affine lane slices
+        (one stable argsort: primary sid % n_lanes, secondary sid — so
+        a session's rows stay in arrival order AND contiguous for the
+        coalesced drain) and enqueue; slow closures ride behind an
+        all-lanes barrier. Returns immediately — this is the overlap."""
+        # workers may have parked since new_plan() — the barrier needs
+        # every lane live, so re-arm them before enqueuing anything
+        self.ensure_loop()
+        parts = 0
+        slices = []
+        if plan._chunks:
+            if len(plan._chunks) == 1:
+                midx, sid, opt, fid = plan._chunks[0]
+            else:
+                midx = np.concatenate([c[0] for c in plan._chunks])
+                sid = np.concatenate([c[1] for c in plan._chunks])
+                opt = np.concatenate([c[2] for c in plan._chunks])
+                fid = np.concatenate([c[3] for c in plan._chunks])
+            plan._chunks = []
+            lane = sid % self.n_lanes
+            # stable single-key argsort: lane-major, sid-minor, original
+            # order within a sid (sids are < 2^31 — broker sid counter)
+            order = np.argsort((lane << np.int64(31)) | sid,
+                               kind="stable")
+            # plain lists for the delivery walk: per-row numpy scalar
+            # indexing costs ~3x a list index in the hot loop
+            plan.s_midx = midx[order].tolist()
+            plan.s_sid = sid[order].tolist()
+            plan.s_opt = opt[order].tolist()
+            plan.s_fid = fid[order].tolist()
+            lanes_sorted = lane[order]
+            bounds = np.searchsorted(lanes_sorted,
+                                     np.arange(self.n_lanes + 1))
+            for ln in range(self.n_lanes):
+                lo, hi = int(bounds[ln]), int(bounds[ln + 1])
+                if lo == hi:
+                    continue
+                parts += 1
+                slices.append((ln, lo, hi))
+            self.metrics.inc("pipeline.deliver.rows", len(order))
+        if plan.slow_items:
+            parts += 1
+            plan._barrier_left = self.n_lanes
+            plan._barrier_evt = asyncio.Event()
+        # all fallible work is done: go live, then enqueue (put_nowait
+        # on unbounded queues cannot raise — a half-enqueued plan would
+        # wedge drain()/admit() forever)
+        plan.pending = parts
+        self._live_plans += 1
+        for ln, lo, hi in slices:
+            self._lane_items[ln] += 1
+            self._queues[ln].put_nowait(("slice", plan, lo, hi))
+        if plan.slow_items:
+            # the barrier holds EVERY lane: the slow closures run with
+            # all prior fast deliveries done and nothing overtaking —
+            # the ordering-safe serialization the inline loop had
+            for ln, q in enumerate(self._queues):
+                self._lane_items[ln] += 1
+                q.put_nowait(("barrier", plan))
+        self.metrics.inc("pipeline.deliver.plans")
+        if parts == 0:
+            plan._finalize()
+        else:
+            self._plans.append(plan)
+
+    def _plan_done(self, plan: DeliveryPlan) -> None:
+        try:
+            self._plans.remove(plan)
+        except ValueError:
+            pass    # zero-part plans finalize before tracking
+        self._live_plans -= 1
+        if self._wake is not None:
+            self._wake.set()
+        if self._live_plans == 0:
+            # park the workers: idle tasks pending at loop teardown
+            # would otherwise warn "task was destroyed" on every test
+            for q in self._queues:
+                q.put_nowait(_PARK)
+
+    # ---- flow control (batcher consume stage) ---------------------------
+    async def admit(self) -> None:
+        """Backpressure: block while more than `depth` plans are
+        outstanding. Called by the batcher after enqueuing a plan — the
+        stall propagates to its `_inflight` queue and from there to
+        submit()/enqueue(), instead of dropping or buffering unboundedly."""
+        if self._wake is None or self._live_plans <= self.depth:
+            return
+        self.metrics.inc("pipeline.deliver.backpressure_waits")
+        while self._live_plans > self.depth:
+            self._wake.clear()
+            await self._wake.wait()
+
+    async def drain(self) -> None:
+        """Wait for every outstanding plan to finish delivering. Host-
+        routed batches call this before delivering inline, so a host
+        batch can never overtake lane-queued deliveries for a session
+        (the device/host FIFO contract the batcher's consumer enforces
+        extends through the lanes)."""
+        if self._wake is None:
+            return
+        while self._live_plans > 0:
+            self._wake.clear()
+            await self._wake.wait()
+
+    def busy(self) -> bool:
+        return self._live_plans > 0
+
+    def queued_items(self) -> int:
+        return sum(self._lane_items)
+
+    def lane_depth(self) -> int:
+        """Deepest lane (pending work items) right now — the exported
+        gauge (park sentinels are housekeeping, not work: excluded)."""
+        return max(self._lane_items, default=0)
+
+    # ---- telemetry ------------------------------------------------------
+    def state(self) -> dict:
+        return {
+            "lanes": self.n_lanes,
+            "depth_limit": self.depth,
+            "live_plans": self._live_plans,
+            "queued_items": self.queued_items(),
+            "lane_depth": self.lane_depth(),
+            "paused": self._paused,
+        }
+
+    def stats_fun(self, stats) -> None:
+        """Registered on Node.stats: the point-in-time lane-depth gauge
+        every exporter carries (Prometheus gauge family, StatsD |g,
+        $SYS stats/)."""
+        stats.setstat("pipeline.deliver.lane_depth", self.lane_depth())
+        stats.setstat("pipeline.deliver.live_plans", self._live_plans)
+
+    # ---- lane workers ---------------------------------------------------
+    async def _worker(self, lane: int) -> None:
+        q = self._queues[lane]
+        tele = self.telemetry
+        while True:
+            item = await q.get()
+            if item[0] == "park":
+                if self._live_plans == 0 and q.empty():
+                    return
+                continue
+            if not self._gate.is_set():
+                await self._gate.wait()
+            t0 = time.perf_counter()
+            worked = True
+            if item[0] == "slice":
+                _k, plan, lo, hi = item
+                try:
+                    await self._run_slice(plan, lane, lo, hi)
+                finally:
+                    plan._finish_part()
+            else:  # barrier
+                _k, plan = item
+                plan._barrier_left -= 1
+                if plan._barrier_left == 0:
+                    try:
+                        await self._run_slow(plan)
+                    finally:
+                        plan._barrier_evt.set()
+                        plan._finish_part()
+                else:
+                    # waiting out another lane's slow tail is not THIS
+                    # lane's work: recording it would read as uniform
+                    # slowness and mask real per-lane hashing skew in
+                    # the deliver_lane{i} histograms
+                    worked = False
+                    await plan._barrier_evt.wait()
+            self._lane_items[lane] -= 1
+            if tele is not None and worked:
+                tele.observe_stage(f"deliver_lane{lane}",
+                                   time.perf_counter() - t0)
+
+    async def _run_slice(self, plan: DeliveryPlan, lane: int,
+                         lo: int, hi: int) -> None:
+        """Deliver one lane's slice, coalescing same-session runs, with
+        a cooperative yield between chunks so a huge fan-out cannot
+        monopolize the loop (other lanes and the producer keep running;
+        later plans queue behind this one per-lane, so order holds)."""
+        sids = plan.s_sid
+        pos = lo
+        while pos < hi:
+            nxt = min(hi, pos + self._chunk)
+            # never split a same-session run across chunks: the
+            # coalesced drain and its all-or-none accept are per run
+            while nxt < hi and sids[nxt] == sids[nxt - 1]:
+                nxt += 1
+            self._deliver_rows(plan, pos, nxt)
+            pos = nxt
+            if pos < hi:
+                await asyncio.sleep(0)
+
+    def _deliver_rows(self, plan: DeliveryPlan, lo: int, hi: int) -> None:
+        broker = self.broker
+        registry = broker._subscribers
+        meta = broker._sub_meta
+        hooks = self.hooks
+        delivered_cbs = hooks.lookup("message.delivered") \
+            if hooks is not None else ()
+        msgs = plan.msgs
+        filters = plan.filters
+        sids, opts = plan.s_sid, plan.s_opt
+        fids, midx = plan.s_fid, plan.s_midx
+        delivered = 0
+        drains = 0
+        # one DeliveryView per (message, packed subopts), shared across
+        # the fan-out: at fan-out F this builds 1 view instead of F. The
+        # share is safe by the copy-on-write contract — every mutation
+        # path on the view (set_header/set_flag/update_expiry/copy)
+        # materializes private state, and delivered messages are
+        # read-only by protocol (Subscriber docstring in pubsub.py).
+        vcache: dict[int, DeliveryView] = {}
+        delivered_midx: list[int] = []
+        i = lo
+        while i < hi:
+            sid = sids[i]
+            j = i + 1
+            while j < hi and sids[j] == sid:
+                j += 1
+            sub = registry.get(sid)
+            if sub is None:
+                i = j
+                continue
+            items = []
+            for k in range(i, j):
+                vk = (midx[k] << 6) | (opts[k] & 0x3F)
+                view = vcache.get(vk)
+                if view is None:
+                    view = vcache[vk] = DeliveryView(
+                        msgs[midx[k]], OPT_TABLE[opts[k] & 0x3F])
+                items.append((filters[fids[k]], view))
+            batch_fn = getattr(sub, "deliver_batch", None) \
+                if j - i > 1 else None
+            # Deliberate divergence from the inline loop: a raising
+            # subscriber/hook here is contained to ITS deliveries
+            # (logged + counted) instead of failing the whole batch's
+            # publish futures — one bad session must not poison every
+            # publisher sharing the window. deliver_errors/slow_errors
+            # make the containment observable.
+            if batch_fn is not None:
+                # coalesced drain: one session accept + one socket
+                # write for the whole run (all-or-none by contract)
+                try:
+                    got = batch_fn(items)
+                except Exception:  # noqa: BLE001 — one bad subscriber
+                    log.exception("deliver_batch failed sid=%s", sid)
+                    self.metrics.inc("pipeline.deliver.deliver_errors")
+                    got = 0
+                drains += 1
+                if got:
+                    delivered_midx.extend(midx[i:j])
+                    delivered += len(items)
+                    if delivered_cbs:
+                        for _f, v in items:
+                            hooks.run("message.delivered",
+                                      (meta.get(sid), v))
+            else:
+                drains += j - i
+                for k, (f, view) in zip(range(i, j), items):
+                    try:
+                        ok = sub.deliver(f, view)
+                    except Exception:  # noqa: BLE001
+                        log.exception("deliver failed sid=%s", sid)
+                        self.metrics.inc(
+                            "pipeline.deliver.deliver_errors")
+                        ok = False
+                    if ok:
+                        delivered_midx.append(midx[k])
+                        delivered += 1
+                        if delivered_cbs:
+                            hooks.run("message.delivered",
+                                      (meta.get(sid), view))
+            i = j
+        if delivered_midx:
+            np.add.at(plan.counts, delivered_midx, 1)
+        # per-slice (not per-row) bookkeeping: the batching win the
+        # coalesce.ratio histogram quantifies
+        metrics = self.metrics
+        if delivered:
+            metrics.inc("messages.delivered", delivered)
+            if plan.routed_device:
+                metrics.inc("messages.routed.device", delivered)
+        n_rows = hi - lo
+        metrics.inc("pipeline.deliver.deliveries", n_rows)
+        metrics.inc("pipeline.deliver.drains", drains)
+        if n_rows:
+            metrics.hist("pipeline.deliver.coalesce.ratio",
+                         lo=1.0 / 256, n_buckets=9,
+                         unit="ratio").observe(1.0 - drains / n_rows)
+
+    async def _run_slow(self, plan: DeliveryPlan) -> None:
+        """The ordering-safe serialized tail: slow-path messages in
+        batch order, all lanes held at the barrier."""
+        for n, (idx, fn) in enumerate(plan.slow_items):
+            try:
+                plan.counts[idx] = fn()
+            except Exception:  # noqa: BLE001 — a failing hook/deliver
+                log.exception("slow-path consume failed")  # != lost lane
+                self.metrics.inc("pipeline.deliver.slow_errors")
+            if n % 64 == 63:
+                await asyncio.sleep(0)

@@ -82,6 +82,7 @@ from typing import Optional
 
 import numpy as np
 
+from emqx_tpu.broker.deliver import DEFERRED, OPT_TABLE, LaneCounts
 from emqx_tpu.broker.match_cache import DEFAULT_CAPACITY, MatchCache
 from emqx_tpu.broker.message import Message
 from emqx_tpu.ops.compact import csr_slices
@@ -503,6 +504,14 @@ class DeviceRouteEngine:
         self.dirty_filters: set[str] = set()
         self.dirty_slots: set[tuple] = set()
         self.new_slots_by_filter: dict[str, set[str]] = {}
+        # hostside-mask memo (ISSUE 5 satellite): _fast_deliver used to
+        # rebuild fid_rich + dirty-scatter on EVERY batch while any
+        # filter was dirty; the mask only changes when the dirty set or
+        # the snapshot does, so it is memoized on (snapshot id, dirty
+        # version) — the version bumps on subscribe/unsubscribe churn
+        # (_mark_dirty), never per batch
+        self._dirty_ver = 0
+        self._hostside_memo: Optional[tuple] = None
         from emqx_tpu.ops.trie import HostTrie
         self._delta_trie = HostTrie()
         self._delta_filter: dict[int, str] = {}
@@ -632,6 +641,33 @@ class DeviceRouteEngine:
         section)."""
         return len(self._touched)
 
+    def _mark_dirty(self, f: str) -> None:
+        """dirty_filters.add with the hostside-memo version bump (only
+        on actual growth — the subscribe path's double notification
+        must not churn the memo key twice for one event)."""
+        if f not in self.dirty_filters:
+            self.dirty_filters.add(f)
+            self._dirty_ver += 1
+
+    def _hostside_mask(self, b) -> np.ndarray:
+        """Per-fid host-side delivery mask of snapshot `b` (rich subopts
+        OR dirty membership), memoized on (snapshot id, dirty version).
+        Invalidated by subscribe/unsubscribe churn and snapshot swaps,
+        not per batch."""
+        if not self.dirty_filters:
+            return b.fid_rich
+        key = (b.sid, self._dirty_ver)
+        memo = self._hostside_memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        hs = b.fid_rich.copy()
+        for f in self.dirty_filters:
+            fid = b.fid_of.get(f)
+            if fid is not None:
+                hs[fid] = True
+        self._hostside_memo = (key, hs)
+        return hs
+
     def _enc_filter(self, f: str) -> list:
         """Interned level ids of a filter, memoized across builds: word
         ids are append-only for the process lifetime (ops/intern.py), so
@@ -680,7 +716,7 @@ class DeviceRouteEngine:
             return
         if added:
             if topic_filter in self._built.fid_of:
-                self.dirty_filters.add(topic_filter)
+                self._mark_dirty(topic_filter)
                 self._built_deleted.discard(topic_filter)
             elif topic_filter not in self._delta_fid_of:
                 words = self._enc_filter(topic_filter)
@@ -693,7 +729,7 @@ class DeviceRouteEngine:
                     self._overlay_changed(words)
         else:
             if topic_filter in self._built.fid_of:
-                self.dirty_filters.add(topic_filter)
+                self._mark_dirty(topic_filter)
                 self._built_deleted.add(topic_filter)
             fid = self._delta_fid_of.pop(topic_filter, None)
             if fid is not None:
@@ -714,7 +750,7 @@ class DeviceRouteEngine:
             return
         if group is None:
             if real in self._built.fid_of:
-                self.dirty_filters.add(real)
+                self._mark_dirty(real)
             elif self.delta_overlay:
                 fid = self._delta_fid_of.get(real)
                 if fid is not None:
@@ -1052,6 +1088,8 @@ class DeviceRouteEngine:
         from emqx_tpu.ops.trie import HostTrie
         self._cluster_groups_cache = {}
         self.dirty_filters = set()
+        self._dirty_ver += 1
+        self._hostside_memo = None
         self.dirty_slots = set()
         self.new_slots_by_filter = {}
         self._delta_trie = HostTrie()
@@ -2412,9 +2450,11 @@ class DeviceRouteEngine:
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
 
-    def finish_sub(self, h, k: int) -> list[int]:
+    def finish_sub(self, h, k: int, defer: bool = True) -> list[int]:
         """Stage 4 (event loop): consume sub-batch k of the window into
-        deliveries. Releases one handle reference.
+        deliveries. Releases one handle reference (deferred to plan
+        completion when the lanes own the deliveries — the snapshot
+        swap gate must cover in-flight lane work).
 
         The clean common case — local node, no delta/dirty filters, no
         shared involvement for the message — is consumed by ONE
@@ -2423,10 +2463,21 @@ class DeviceRouteEngine:
         match/fan-out rows used to cost more than the entire host route
         (24ms vs 22ms per 1024-batch at 50k filters), which made the
         device unable to win e2e no matter how fast the chip was.
-        Messages the fast path can't prove clean fall through to
-        _consume_one unchanged."""
+
+        With the delivery lanes active (ISSUE 5; `defer=True` and a
+        DeliveryLanePool on the node), this stage only BUILDS the
+        delivery plan: clean messages' rows are bucketed into
+        session-affine lanes, slow messages become ordered closures
+        behind the plan's barrier, and the returned LaneCounts is
+        back-filled when the plan completes (the `deliver` stage
+        histogram then measures plan construction; the delivery time
+        itself lands in the per-lane deliver_lane{i} histograms).
+        `defer=False` (sync callers: route_batch/finish) keeps the
+        inline consume — counts are final on return."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         t0 = time.perf_counter()
+        plan = None
+        deferred = False
         try:
             nr = h.np_res
             msgs, words_list, too_long = h.subs[k]
@@ -2451,19 +2502,41 @@ class DeviceRouteEngine:
                 self._writeback_cursors(occur_k, b)
             metrics = self.node.metrics
             broker = self.broker
+            if defer:
+                pool = getattr(self.node, "deliver_lanes", None)
+                if pool is not None and pool.active():
+                    plan = pool.new_plan(msgs)  # None without a loop
+                    if plan is not None:
+                        plan.routed_device = True
             if csr:
                 fast = self._consume_batch_fast_csr(
                     msgs, nr.off[k], nr.c3[k], nr.pay[k], too_long,
-                    overflow_k, h.dev_shared, b, d_counts_k, pending)
+                    overflow_k, h.dev_shared, b, d_counts_k, pending,
+                    plan=plan)
             else:
                 fast = self._consume_batch_fast(
                     msgs, matches[k], rows[k], opts[k], shared_sids[k],
                     too_long, overflow_k, h.dev_shared, b, d_counts_k,
-                    pending)
+                    pending, plan=plan)
+            dev_shared, ov = h.dev_shared, h.delta
             counts: list[int] = []
             for i, msg in enumerate(msgs):
-                if fast[i] is not None:
-                    counts.append(fast[i])
+                f_i = fast[i]
+                if f_i is DEFERRED:
+                    counts.append(0)      # back-filled at plan finalize
+                    continue
+                if f_i is not None:
+                    counts.append(f_i)
+                    continue
+                if plan is not None:
+                    # slow path under lanes: an ordered closure behind
+                    # the plan's barrier — it runs with every prior
+                    # fast delivery done and nothing overtaking, the
+                    # exact interleaving of the inline loop
+                    counts.append(0)
+                    plan.add_slow(i, self._make_slow_fn(
+                        h, k, i, msg, b, csr, nr, nd, words_list,
+                        too_long, overflow_k, dev_shared, ov, pending))
                     continue
                 if too_long[i] or overflow_k[i]:
                     metrics.inc("routing.device.host_fallback")
@@ -2495,15 +2568,57 @@ class DeviceRouteEngine:
                     h.dev_shared, b, drow=drow, ov=h.delta,
                     pending=pending))
             metrics.inc("routing.device.batches")
+            if plan is not None:
+                out = LaneCounts(counts)
+                out.plan = plan
+                plan.target = out
+                # the handle stays pinned until the lanes finish: slow
+                # closures read live engine state against this snapshot,
+                # and _try_swap must not rebase it under them
+                plan.add_done_callback(lambda: self._release_one(h))
+                pool.submit(plan)
+                deferred = True
+                return out
             return counts
         finally:
             if tele is not None:
                 tele.observe_stage("deliver", time.perf_counter() - t0)
-            self._release_one(h)
+            if not deferred:
+                self._release_one(h)
+
+    def _make_slow_fn(self, h, k: int, i: int, msg, b, csr, nr, nd,
+                      words_list, too_long, overflow_k, dev_shared,
+                      ov, pending):
+        """Build the deferred slow-path consume for one message (runs
+        behind the plan barrier; the handle is pinned until then)."""
+        def run() -> int:
+            if too_long[i] or overflow_k[i]:
+                self.node.metrics.inc("routing.device.host_fallback")
+                return self.broker._route(
+                    msg, self.router.match(msg.topic))
+            if csr:
+                row6 = csr_slices(nr.off[k], nr.c3[k], nr.pay[k], i)
+            else:
+                row6 = (nr[0][k][i], nr[1][k][i], nr[2][k][i],
+                        nr[3][k][i], nr[4][k][i], nr[5][k][i])
+            drow = None
+            if nd is not None:
+                if isinstance(nd, _DeltaCsr):
+                    drow = csr_slices(nd.off[k], nd.c3[k],
+                                      nd.pay[k], i)[:3]
+                else:
+                    drow = (nd.fids[k][i], nd.rows[k][i],
+                            nd.opts[k][i])
+            return self._consume_one(
+                msg, *row6,
+                words_list[i] if words_list is not None else None,
+                dev_shared, b, drow=drow, ov=ov, pending=pending)
+        return run
 
     def _consume_batch_fast(self, msgs, m_k, r_k, o_k, ss_k, too_long,
                             overflow_k, dev_shared: bool, b,
-                            d_counts_k=None, pending: bool = False):
+                            d_counts_k=None, pending: bool = False,
+                            plan=None):
         """Vectorized consume for provably-clean messages. Returns a list
         with per-message delivery counts, or None where the slow path
         must run. Clean requires, globally: standalone node (no cluster
@@ -2526,11 +2641,12 @@ class DeviceRouteEngine:
 
         return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
                                   shared_any, fetch, dev_shared, b,
-                                  d_counts_k)
+                                  d_counts_k, plan=plan)
 
     def _consume_batch_fast_csr(self, msgs, off_k, c3_k, pay_k, too_long,
                                 overflow_k, dev_shared: bool, b,
-                                d_counts_k=None, pending: bool = False):
+                                d_counts_k=None, pending: bool = False,
+                                plan=None):
         """_consume_batch_fast over one window row's CSR planes: same
         clean-message proof and the same vectorized delivery walk, with
         the 2-D plane gathers replaced by flat payload gathers at each
@@ -2561,28 +2677,47 @@ class DeviceRouteEngine:
 
         return self._fast_deliver(msgs, mi, fids, too_long, overflow_k,
                                   shared_any, fetch, dev_shared, b,
-                                  d_counts_k)
+                                  d_counts_k, plan=plan)
+
+    @staticmethod
+    def _attribute_rows(mi_f, fids_f, seg, total: int):
+        """Row attribution shared by the inline loop and the lane plan:
+        within each message the fan-out rows are the concatenation of
+        per-filter CSR segments in match order. Returns (row_msg, col,
+        row_fid) — for every fan-out row, its message index, its column
+        within that message's fan-out, and the filter it came from."""
+        csum = np.cumsum(seg) - seg                # global exclusive
+        starts = np.flatnonzero(np.r_[True, mi_f[1:] != mi_f[:-1]])
+        base = np.repeat(csum[starts], np.diff(np.r_[starts,
+                                                     mi_f.size]))
+        within = csum - base                       # offset inside msg
+        row_msg = np.repeat(mi_f, seg)
+        ar = np.arange(total)
+        row_local = ar - np.repeat(csum, seg)
+        col = np.repeat(within, seg) + row_local
+        row_fid = np.repeat(fids_f, seg)
+        return row_msg, col, row_fid
 
     def _fast_deliver(self, msgs, mi, fids, too_long, overflow_k,
                       shared_any, fetch, dev_shared: bool, b,
-                      d_counts_k=None):
+                      d_counts_k=None, plan=None):
         """Shared tail of the vectorized fast consume (dense and CSR):
-        per-message clean proof, row attribution, delivery, and the
-        no-subscriber bookkeeping. `mi`/`fids` list every valid match
-        (message index, filter id) in match order; `fetch(row_msg, col)`
-        gathers the (sid, packed opts) of fan-out entry `col` within
-        message `row_msg`."""
+        per-message clean proof, row attribution, and delivery. `mi`/
+        `fids` list every valid match (message index, filter id) in
+        match order; `fetch(row_msg, col)` gathers the (sid, packed
+        opts) of fan-out entry `col` within message `row_msg`.
+
+        With `plan` attached (ISSUE 5: deliver lanes active) this stops
+        looping entirely: the gathered (row_msg, sid, opt, fid) arrays
+        are handed to the plan, which buckets them into session-affine
+        lane slices — delivery (and the no-subscriber bookkeeping for
+        these messages) then overlaps the next window's dispatch.
+        `plan=None` is the inline A/B baseline (deliver_lanes=0 or no
+        running loop): the per-row loop below, unchanged semantics."""
         broker = self.broker
         B = len(msgs)
-        # per-fid host-side mask: rich is snapshot-constant (precomputed
-        # at build); only the usually-empty dirty set costs per-batch work
-        hostside = b.fid_rich
-        if self.dirty_filters:
-            hostside = hostside.copy()
-            for f in self.dirty_filters:
-                fid = b.fid_of.get(f)
-                if fid is not None:
-                    hostside[fid] = True
+        # per-fid host-side mask, memoized on (snapshot, dirty version)
+        hostside = self._hostside_mask(b)
 
         slow = np.asarray(too_long[:B]) | (overflow_k[:B] != 0)
         if d_counts_k is not None:
@@ -2605,34 +2740,41 @@ class DeviceRouteEngine:
         mi_f, fids_f = mi[keep], fids[keep]
         seg = b.seg_np[fids_f]
         total = int(seg.sum())
+        if plan is not None:
+            # lane hand-off: one gather pass, zero Python per-row work
+            # here — the lanes deliver these messages off this stage
+            fast_idx = np.flatnonzero(fast_ok)
+            plan.register_fast(fast_idx)
+            if total:
+                row_msg, col, row_fid = self._attribute_rows(
+                    mi_f, fids_f, seg, total)
+                sid, opt = fetch(row_msg, col)
+                valid = sid >= 0
+                plan.add_rows(row_msg[valid], sid[valid], opt[valid],
+                              row_fid[valid], b.fid_filter)
+            for i in fast_idx.tolist():
+                out[i] = DEFERRED
+            return out
         counts = np.zeros(B, np.int64)
         delivered = 0
         if total:
-            # row attribution: within each message the fan-out rows are
-            # the concatenation of per-filter segments in match order
-            csum = np.cumsum(seg) - seg            # global exclusive
-            starts = np.flatnonzero(np.r_[True, mi_f[1:] != mi_f[:-1]])
-            base = np.repeat(csum[starts], np.diff(np.r_[starts,
-                                                         mi_f.size]))
-            within = csum - base                   # offset inside msg
-            row_msg = np.repeat(mi_f, seg)
-            ar = np.arange(total)
-            row_local = ar - np.repeat(csum, seg)
-            col = np.repeat(within, seg) + row_local
-            row_fid = np.repeat(fids_f, seg)
+            row_msg, col, row_fid = self._attribute_rows(
+                mi_f, fids_f, seg, total)
             sid, opt = fetch(row_msg, col)
             valid = sid >= 0
             fid_filter = b.fid_filter
             deliver = broker._deliver
-            opt_cache: dict[int, dict] = {}
+            # the 64-entry OPT_TABLE replaces the old per-call
+            # opt_cache (ISSUE 5 satellite); the dict copy stays on
+            # this inline path because _deliver plants the dict into
+            # the delivered copy's headers — the lane path instead
+            # shares the frozen table entry through the DeliveryView
             for bi, s, ob, fd in zip(row_msg[valid].tolist(),
                                      sid[valid].tolist(),
                                      opt[valid].tolist(),
                                      row_fid[valid].tolist()):
-                so = opt_cache.get(ob)
-                if so is None:
-                    so = opt_cache[ob] = _unpack_opts(ob)
-                if deliver(s, fid_filter[fd], msgs[bi], dict(so)):
+                if deliver(s, fid_filter[fd], msgs[bi],
+                           dict(OPT_TABLE[ob & 0x3F])):
                     counts[bi] += 1
                     delivered += 1
         if delivered:
@@ -2649,8 +2791,10 @@ class DeviceRouteEngine:
         return out
 
     def finish(self, h) -> list[int]:
-        """Stage 4 for single-batch callers (route_batch): window of 1."""
-        return self.finish_sub(h, 0)
+        """Stage 4 for single-batch callers (route_batch): window of 1.
+        Sync callers need final counts on return, so the consume stays
+        inline (the lanes serve the pipelined path via finish_sub)."""
+        return self.finish_sub(h, 0, defer=False)
 
     def _release_one(self, h) -> None:
         """Drop one sub-batch reference; the handle releases at zero."""

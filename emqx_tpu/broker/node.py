@@ -64,7 +64,23 @@ class Node:
                                          False))
         self.device_engine = None
         self.publish_batcher = None
+        # session-affine delivery lanes (ISSUE 5): the overlapped egress
+        # stage both engines' consume hands plans to. 0 lanes (config
+        # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores
+        # the inline delivery loop exactly — the A/B baseline.
+        self.deliver_lanes = None
+        from emqx_tpu.broker.deliver import (DeliveryLanePool,
+                                             resolve_deliver_lanes)
+        n_lanes = resolve_deliver_lanes(perf.get("deliver_lanes"))
         mc = perf.get("multichip") or {}
+        if n_lanes > 0 and (use_device or mc.get("enable")):
+            self.deliver_lanes = DeliveryLanePool(
+                self.broker, self.metrics, hooks=self.hooks,
+                telemetry=self.pipeline_telemetry, n_lanes=n_lanes,
+                depth=perf.get("deliver_lane_depth", 8))
+            self.pipeline_telemetry.deliver_state_fn = \
+                self.deliver_lanes.state
+            self.stats.register_stats_fun(self.deliver_lanes.stats_fun)
         if mc.get("enable"):
             # multichip serving mode: route through a dp×route device
             # mesh (parallel.serving) instead of the single-chip engine;

@@ -30,7 +30,21 @@ SHARED_STRATEGIES = ("random", "round_robin", "sticky", "hash_clientid",
 
 class Subscriber(Protocol):
     def deliver(self, topic_filter: str, msg: Message) -> bool:
-        """Deliver one routed message; False = nack (shared redispatch)."""
+        """Deliver one routed message; False = nack (shared redispatch).
+
+        `msg` is either a full Message copy (the host/inline paths —
+        `_deliver` below) or a `broker.deliver.DeliveryView` (the
+        ISSUE-5 delivery-lane fast path): a copy-on-write view sharing
+        the routed message's payload/headers with `subopts` overlaid.
+        Both quack the same; treat the delivered message's `subopts`
+        as frozen (views share one 64-entry unpacked-subopts table).
+
+        Subscribers MAY also implement
+        `deliver_batch(items: list[tuple[str, Message]]) -> int`
+        (all-or-none accept; returns len(items) or 0): the delivery
+        lanes coalesce a same-session run of messages into one call so
+        the session accept + socket drain amortize across the run.
+        Without it, the lanes fall back to per-message deliver()."""
 
 
 @dataclass
@@ -267,6 +281,10 @@ class Broker:
 
     def _deliver(self, sid: int, topic_filter: str, msg: Message,
                  subopts: dict) -> bool:
+        # the per-subscriber copy + header plant is the ordering-safe
+        # inline baseline (deliver_lanes=0 A/B anchor); the ISSUE-5 lane
+        # fast path replaces it with a copy-on-write DeliveryView and
+        # batches the metric/hook tail per lane slice (broker/deliver.py)
         sub = self._subscribers.get(sid)
         if sub is None:
             return False

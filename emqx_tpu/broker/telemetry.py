@@ -17,7 +17,11 @@ for every pipeline stage —
                  match latency split falls straight out of comparing the
                  two histograms
     materialize  device->host readbacks
-    deliver      RouteResult consumption into session deliveries
+    deliver      RouteResult consumption into session deliveries (with
+                 the ISSUE-5 delivery lanes active this is the PLAN
+                 construction span; the delivery walk itself lands in
+                 the per-lane deliver_lane{i} histograms below)
+    deliver_lane{i}  one delivery-lane item (slice or barrier) on lane i
     host_route   host-path match + route span for host-routed batches
     host_match   per-message host trie match latency (sampled 1-in-32 —
                  the host-side decomposition of dispatch's match stage)
@@ -119,6 +123,9 @@ class PipelineTelemetry:
         # values the counter registry can't carry. Best-effort: snapshot
         # must keep working on nodes without a device engine.
         self.rebuild_state_fn = None
+        # live delivery-lane gauges provider (set by the node when the
+        # ISSUE-5 DeliveryLanePool exists): lane depth, live plans
+        self.deliver_state_fn = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -363,6 +370,28 @@ class PipelineTelemetry:
                 rebuild["state"] = self.rebuild_state_fn()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # delivery-lane egress stage (ISSUE 5): coalesce/backpressure
+        # counters + the pool's live gauges. `coalesce_ratio` is the
+        # fraction of per-row session drains the coalescing removed
+        # (rows vs actual deliver calls); lane depth rides the Stats
+        # gauge table too (pipeline.deliver.lane_depth), so Prometheus/
+        # StatsD/$SYS stats all carry the point-in-time value.
+        deliver = {}
+        for key in ("rows", "plans", "deliveries", "drains",
+                    "backpressure_waits", "deliver_errors",
+                    "slow_errors"):
+            v = self.metrics.val(f"pipeline.deliver.{key}")
+            if v:
+                deliver[key] = v
+        if deliver.get("deliveries"):
+            deliver["coalesce_ratio"] = round(
+                1.0 - deliver.get("drains", 0) / deliver["deliveries"],
+                4)
+        if self.deliver_state_fn is not None:
+            try:
+                deliver["state"] = self.deliver_state_fn()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -372,6 +401,8 @@ class PipelineTelemetry:
         }
         if rebuild:
             out["rebuild"] = rebuild
+        if deliver:
+            out["deliver"] = deliver
         if cache:
             out["match_cache"] = cache
         if dedup:

@@ -54,6 +54,7 @@ import numpy as np
 from emqx_tpu.broker.device_engine import (_REMOTE_SID_BASE, _is_rich,
                                            _next_pow2, _pack_opts,
                                            _unpack_opts, capture_shared)
+from emqx_tpu.broker.deliver import LaneCounts
 from emqx_tpu.broker.message import Message
 from emqx_tpu.ops import intern as I
 from emqx_tpu.ops.compact import csr_slices
@@ -794,22 +795,116 @@ class ShardedRouteServer:
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
 
-    def finish_sub(self, h: _Handle, k: int) -> list[int]:
-        """Stage 4 (event loop): consume into deliveries (W=1: k==0)."""
+    def finish_sub(self, h: _Handle, k: int,
+                   defer: bool = True) -> list[int]:
+        """Stage 4 (event loop): consume into deliveries (W=1: k==0).
+
+        Reuses the ISSUE-5 delivery-lane pool when the node carries one
+        (`defer=True`, the pipelined path): messages whose every
+        delivery is a plain local fan-out row are collected into the
+        session-affine plan (_collect_clean), everything else —
+        host-forced, overflow, shared groups, rich filters, too-deep
+        host_extra, clustered — rides the plan's ordered barrier
+        closures, so the per-session interleaving matches the inline
+        loop exactly. `defer=False` (route_batch) stays inline."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         t0 = time.perf_counter()
         msgs = h.subs[k]
         np_res = h.np_res
-        counts = []
+        plan = None
+        pool = None
+        if defer:
+            pool = getattr(self.node, "deliver_lanes", None)
+            if pool is not None and pool.active():
+                plan = pool.new_plan(msgs)  # None without a loop
+                if plan is not None:
+                    plan.routed_device = True
+        counts: list[int] = []
         for i, msg in enumerate(msgs):
             if i in h.host_idx or bool(np_res["overflow"][i].any()):
-                counts.append(self._host_route(msg))
+                if plan is not None:
+                    counts.append(0)
+                    plan.add_slow(i, lambda m=msg: self._host_route(m))
+                else:
+                    counts.append(self._host_route(msg))
+                continue
+            if plan is not None:
+                rows = self._collect_clean(msg, i, np_res, h.built)
+                counts.append(0)
+                if rows is not None:
+                    plan.register_fast([i])
+                    plan.add_rows_py(i, rows)
+                else:
+                    plan.add_slow(
+                        i, lambda m=msg, j=i: self._consume_one(
+                            m, j, np_res, h.built))
                 continue
             counts.append(self._consume_one(msg, i, np_res, h.built))
         self._writeback_cursors(np_res["occur"], h.built)
+        if plan is not None:
+            out = LaneCounts(counts)
+            out.plan = plan
+            plan.target = out
+            pool.submit(plan)
+            counts = out
         if tele is not None:
             tele.observe_stage("deliver", time.perf_counter() - t0)
         return counts
+
+    def _collect_clean(self, msg, i: int, np_res, builts):
+        """Clean-proof + row collection for the delivery lanes: returns
+        [(sid, packed_opt, filter)] when EVERY delivery of this message
+        is a plain local fan-out row — standalone node, no shared group
+        on any matched filter, no rich filter, no device shared-slot
+        hit, no too-deep host_extra on any shard — else None (the
+        ordering-safe _consume_one closure serves it)."""
+        broker = self.broker
+        if broker.cluster is not None:
+            return None
+        csr = np_res.get("csr")
+        # pass 1 — fid-level disqualifier scan ONLY (no per-row work):
+        # a slow message's deferred _consume_one repeats the full walk,
+        # so collecting rows before the verdict would double the
+        # per-row Python cost for exactly the messages that gain
+        # nothing from it
+        decoded = []
+        for r in range(self.n_route):
+            b = builts[r]
+            if b.host_extra:
+                return None
+            if csr is not None:
+                (row_m, rows, opts, srow, _prow, _orow) = csr_slices(
+                    csr[0], csr[1], csr[2], i * self.n_route + r)
+            else:
+                row_m = np_res["matches"][i, r]
+                rows = np_res["rows"][i, r]
+                opts = np_res["opts"][i, r]
+                srow = np_res["shared_sids"][i, r]
+            for slot in srow:
+                if slot >= 0:
+                    return None
+            for fid in row_m:
+                if fid < 0:
+                    continue
+                f = b.fid_filter[fid]
+                if f in b.rich or broker.shared.get(f):
+                    return None
+            decoded.append((b, row_m, rows, opts))
+        # pass 2 — proven clean: collect the fan-out rows
+        out: list[tuple] = []
+        for b, row_m, rows, opts in decoded:
+            off = 0
+            for fid in row_m:
+                if fid < 0:
+                    continue
+                f = b.fid_filter[fid]
+                seg = b.seg_len[fid]
+                for j in range(off, off + seg):
+                    sid = int(rows[j])
+                    if sid >= 0:
+                        out.append((sid, int(opts[j]), f))
+                off += seg
+        return out
 
     def _writeback_cursors(self, occur, builts) -> None:
         """Mirror device round-robin advances onto the host
@@ -829,7 +924,8 @@ class ShardedRouteServer:
                         % len(g.members)
 
     def finish(self, h: _Handle) -> list[int]:
-        return self.finish_sub(h, 0)
+        # sync callers need final counts: inline consume, no lanes
+        return self.finish_sub(h, 0, defer=False)
 
     # ---- consume --------------------------------------------------------
     def _host_route(self, msg: Message) -> int:
