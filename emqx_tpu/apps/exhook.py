@@ -182,7 +182,8 @@ class ExhookServer:
         def fire(*args):
             try:
                 asyncio.get_running_loop()
-                asyncio.ensure_future(notify(args))
+                from emqx_tpu.broker.supervise import spawn
+                spawn(notify(args), "exhook-notify")
             except RuntimeError:
                 # no loop (sync test context): deliver inline, blocking
                 try:

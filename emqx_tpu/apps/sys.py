@@ -74,7 +74,8 @@ class SysBroker:
         `pipeline/match_cache` / `pipeline/dedup` / `pipeline/readback`
         (dense-vs-compact device→host transfer bytes, ISSUE 3) /
         `pipeline/rebuild` / `pipeline/deliver` (delivery-lane egress
-        stage, ISSUE 5)."""
+        stage, ISSUE 5) / `pipeline/supervise` (fault-domain
+        supervision: breaker states, ladder rung, ISSUE 6)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -90,7 +91,7 @@ class SysBroker:
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
-                        "deliver"):
+                        "deliver", "supervise"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

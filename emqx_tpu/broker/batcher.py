@@ -61,6 +61,11 @@ class PublishBatcher:
         # pipeline telemetry (stage spans / occupancy / decisions) — a
         # Node always carries one; tolerate bare test harness nodes
         self.tele = getattr(node, "pipeline_telemetry", None)
+        # fault-domain supervision (ISSUE 6): the consumer's watchdog
+        # deadlines, the window journal, and the device/host ladder
+        # gate all hang off this. None (knob off / bare test nodes)
+        # restores the pre-ISSUE-6 unwind behavior exactly.
+        self.sup = getattr(node, "supervisor", None)
         self.window_s = window_us / 1e6
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
@@ -133,12 +138,15 @@ class PublishBatcher:
     def _kick(self) -> None:
         if self._inflight is None:
             self._inflight = asyncio.Queue(maxsize=self.pipeline_depth)
+        from emqx_tpu.broker.supervise import guard_task
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
-                self._produce())
+            self._task = guard_task(
+                asyncio.get_running_loop().create_task(self._produce()),
+                "batcher-produce", self.node.metrics)
         if self._consumer is None or self._consumer.done():
-            self._consumer = asyncio.get_running_loop().create_task(
-                self._consume())
+            self._consumer = guard_task(
+                asyncio.get_running_loop().create_task(self._consume()),
+                "batcher-consume", self.node.metrics)
 
     async def stop(self) -> None:
         for t in (self._task, self._consumer):
@@ -165,6 +173,8 @@ class PublishBatcher:
                         fut.set_exception(err)
                 if entry.get("handle") is not None:
                     self.engine.abandon(entry["handle"])
+                if self.sup is not None:
+                    self.sup.journal_settle(entry.get("wid"))
         self._task = None
         self._consumer = None
 
@@ -196,9 +206,19 @@ class PublishBatcher:
                         # its batch formed (upper-bounds the batch)
                         self.tele.observe_stage(
                             "enqueue", time.perf_counter() - t_enq)
-                    return {"batch": batch, "handle": None, "sub": 0,
-                            "dispatch_fut": None, "live": None,
-                            "live_idx": None, "t_enq": t_enq}
+                    entry = {"batch": batch, "handle": None, "sub": 0,
+                             "dispatch_fut": None, "live": None,
+                             "live_idx": None, "t_enq": t_enq}
+                    if self.sup is not None:
+                        # window journal (ISSUE 6): the window is
+                        # journaled the moment it is admitted to the
+                        # pipeline — its (message, publisher-future)
+                        # batch by reference — and settled when its
+                        # counts resolve. A stage death mid-window
+                        # replays exactly this manifest through the
+                        # next ladder rung.
+                        entry["wid"] = self.sup.journal_admit(batch)
+                    return entry
 
                 group = [form_entry()]
                 try:
@@ -209,6 +229,12 @@ class PublishBatcher:
                         # rebuild even when batches are too small for the
                         # device path
                         self.engine.poll_rebuild()
+                    if self.sup is not None:
+                        # supervision tick rides the same cadence: due
+                        # half-open probes launch here even when every
+                        # breaker gates the engine paths shut (the
+                        # probes ARE the way back up the ladder)
+                        self.sup.poll()
                     live0 = group[0]["live"]
                     # the device/host DECISION runs on the first batch
                     # alone, BEFORE any fusion — a host probe (or bypass)
@@ -217,6 +243,15 @@ class PublishBatcher:
                     dispatched = False
                     use_device = (bool(live0) and self.engine is not None
                                   and len(live0) >= self.device_min_batch)
+                    if use_device and self.sup is not None \
+                            and not self.sup.allow_device():
+                        # ladder rung 2 (ISSUE 6): the dispatch or
+                        # materialize breaker is open — this window
+                        # routes through the host trie; the half-open
+                        # probe (off-path) steps the ladder back up
+                        self.node.metrics.inc(
+                            "routing.device.supervised_bypass")
+                        use_device = False
                     if use_device \
                             and not self.engine.batch_class_warm(
                                 len(live0)):
@@ -375,6 +410,10 @@ class PublishBatcher:
         if entry.get("handle") is not None:
             self.engine.abandon(entry["handle"])
             entry["handle"] = None
+        if self.sup is not None:
+            # failed ≠ lost silently: the futures above carry the error
+            # to their publishers, so the journal entry is accounted for
+            self.sup.journal_settle(entry.get("wid"))
 
     async def _fold_hooks(self, entry: dict) -> None:
         """message.publish hook fold, concurrently across the batch."""
@@ -459,6 +498,8 @@ class PublishBatcher:
                 for i, (_m, fut) in enumerate(batch):
                     if fut is not None and not fut.done():
                         fut.set_result(counts[i])
+                if self.sup is not None:
+                    self.sup.journal_settle(entry.get("wid"))
                 # PUBLISH→route latency sample: oldest enqueue →
                 # completion (covers both host- and device-routed
                 # entries — the device path funnels through here with
@@ -486,6 +527,8 @@ class PublishBatcher:
             for _m, fut in batch:
                 if fut is not None and not fut.done():
                     fut.set_exception(e)
+            if self.sup is not None:
+                self.sup.journal_settle(entry.get("wid"))
 
     async def _consume(self) -> None:
         loop = asyncio.get_running_loop()
@@ -517,24 +560,62 @@ class PublishBatcher:
         """Await dispatch + readback off-loop, consume on-loop. Returns the
         per-live-message counts, or None to fall back to the host path.
         Window entries after the first reuse the already-materialized
-        handle (FIFO adjacency guarantees the dispatching entry ran)."""
+        handle (FIFO adjacency guarantees the dispatching entry ran).
+
+        Supervision (ISSUE 6): each stage await is bounded by the
+        supervisor's watchdog deadline (p99-derived) — a hang trips the
+        stage's breaker and replays the window host-side instead of
+        wedging this consumer; stage exceptions are attributed to their
+        fault domain; a consume failure (e.g. a corrupt readback)
+        likewise replays instead of failing the window's publishers.
+        Without a supervisor the pre-ISSUE-6 behavior is bit-exact:
+        unbounded awaits, one catch-all host fallback for dispatch/
+        materialize, consume errors fail the entry."""
         handle = entry["handle"]
         sub = entry.get("sub", 0)
         n_subs = len(handle.subs)
+        sup = self.sup
         if entry["dispatch_fut"] is not None:
             handle.t0 = time.perf_counter()
-            try:
-                await entry["dispatch_fut"]
-                await loop.run_in_executor(self._read_pool,
-                                           self.engine.materialize, handle)
-            except Exception:
-                self.engine.abandon(handle)
-                self.node.metrics.inc("routing.device.dispatch_failed")
-                return None
+            if sup is None:
+                try:
+                    await entry["dispatch_fut"]
+                    await loop.run_in_executor(
+                        self._read_pool, self.engine.materialize, handle)
+                except Exception:
+                    self.engine.abandon(handle)
+                    self.node.metrics.inc(
+                        "routing.device.dispatch_failed")
+                    return None
+            else:
+                if not await self._await_stage(
+                        entry["dispatch_fut"], "dispatch", handle):
+                    return None
+                mat = loop.run_in_executor(
+                    self._read_pool, self.engine.materialize, handle)
+                if not await self._await_stage(mat, "materialize",
+                                               handle):
+                    return None
         if handle.built is None or handle.np_res is None:
             # the window's dispatching entry failed/abandoned earlier
             return None
-        counts = self.engine.finish_sub(handle, sub)
+        if sup is None:
+            counts = self.engine.finish_sub(handle, sub)
+        else:
+            try:
+                counts = self.engine.finish_sub(handle, sub)
+            except Exception as e:
+                # consume died mid-window (corrupt readback / decode
+                # bug): abandon the pinned snapshot and replay the
+                # journaled window through the next rung — the host
+                # path below re-routes every message, so QoS≥1 loses
+                # nothing and per-session order holds (the host
+                # completion drains the lanes first)
+                self.engine.abandon(handle)
+                sup.note_fault("materialize", e)
+                sup.note_replay()
+                self.node.metrics.inc("routing.device.dispatch_failed")
+                return None
         pool = getattr(self.node, "deliver_lanes", None)
         if pool is not None and pool.active():
             # backpressure: too many plans queued in the delivery lanes
@@ -545,6 +626,11 @@ class PublishBatcher:
             await pool.admit()
         done = time.perf_counter()
         if sub == n_subs - 1:
+            if sup is not None:
+                # one healthy window resets the stage breakers'
+                # consecutive-fault counters
+                sup.note_ok("dispatch")
+                sup.note_ok("materialize")
             # ONE cost sample per WINDOW, divided by its width — sampling
             # per entry would count the near-instant later subs of a
             # window as full batches and drag the EWMA to ~zero (the
@@ -562,6 +648,35 @@ class PublishBatcher:
             # slow-start growth: this window completed, widen the next
             self._fuse_cwnd = min(8, max(2, 2 * n_subs))
         return counts
+
+    async def _await_stage(self, fut, stage: str, handle) -> bool:
+        """Await one off-loop stage under the supervisor's watchdog
+        deadline. Returns False (handle abandoned, fault noted, replay
+        counted — caller falls back to the host rung) on timeout or
+        stage exception; True on success. The deadline derives from the
+        stage histogram's p99, so a legitimately-slow relay link earns
+        a proportionally longer leash (supervise.deadline)."""
+        sup = self.sup
+        try:
+            await asyncio.wait_for(fut, sup.deadline(stage))
+        except asyncio.CancelledError:
+            raise
+        except asyncio.TimeoutError:
+            # the executor thread may still be wedged inside the stage —
+            # the breaker keeps further windows off the device while it
+            # is; this consumer moves on instead of wedging with it
+            self.engine.abandon(handle)
+            self.node.metrics.inc("routing.device.dispatch_failed")
+            sup.note_stall(stage)
+            sup.note_replay()
+            return False
+        except Exception as e:
+            self.engine.abandon(handle)
+            self.node.metrics.inc("routing.device.dispatch_failed")
+            sup.note_fault(stage, e)
+            sup.note_replay()
+            return False
+        return True
 
     def lat_percentiles(self) -> Optional[dict]:
         """PUBLISH→route latency percentiles (ms) over the reservoir."""

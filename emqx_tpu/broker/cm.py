@@ -121,8 +121,8 @@ class ConnectionManager:
         if old is not None:
             try:
                 await old.kick("discarded")
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — the old channel may be
+                pass           # half-dead already; the takeover wins
 
     async def kick_session(self, clientid: str) -> bool:
         """Administrative kick (emqx_cm:kick_session)."""
@@ -132,8 +132,8 @@ class ConnectionManager:
         self.unregister_channel(clientid)
         try:
             await old.kick("kicked")
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — a dying channel must not
+            pass           # fail the administrative kick
         return True
 
     # ---- persistent-session parking ----

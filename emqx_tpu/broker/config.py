@@ -62,6 +62,16 @@ DEFAULTS: dict = {
         # max outstanding delivery plans before the batcher's consumer
         # blocks (backpressure up through _inflight to submit/enqueue)
         "deliver_lane_depth": 8,
+        # None = resolve via EMQX_TPU_SUPERVISE, then default-on
+        # (broker/supervise.resolve_supervise); false restores the
+        # pre-ISSUE-6 ad-hoc unwind behavior exactly (no breakers,
+        # watchdogs, fault injection or window journal) — the chaos
+        # A/B baseline. A baked-in bool here would shadow the env knob
+        # through the defaults merge.
+        "supervise": None,
+        # consecutive faults before a stage's circuit breaker opens
+        # (None = EMQX_TPU_BREAKER_THRESHOLD, then 3)
+        "supervise_threshold": None,
         "perf": {"trie_compaction": True},
     },
     "zones": {},                 # zone name -> {mqtt: {...}} overrides

@@ -83,7 +83,10 @@ class Connection:
         peer = self.channel.conninfo.get("peername")
         if peer:
             set_metadata_peername(f"{peer[0]}:{peer[1]}")
-        self._timer_task = asyncio.ensure_future(self._timers())
+        from emqx_tpu.broker.supervise import guard_task
+        self._timer_task = guard_task(
+            asyncio.ensure_future(self._timers()), "conn-timers",
+            self.node.metrics)
         reason = "closed"
         try:
             idle_timeout = self.node.config.mqtt(
@@ -154,13 +157,13 @@ class Connection:
             except (asyncio.CancelledError, KeyboardInterrupt, SystemExit):
                 try:
                     self.writer.transport.abort()
-                except Exception:
+                except Exception:  # noqa: BLE001 — transport already gone
                     pass
                 raise               # preserve the cancellation contract
             except Exception:       # TimeoutError, reset mid-flush, ...
                 try:
                     self.writer.transport.abort()
-                except Exception:
+                except Exception:  # noqa: BLE001 — transport already gone
                     pass
 
     def _frame_error_out(self, e: FrameError) -> None:

@@ -484,11 +484,17 @@ class DeliveryLanePool:
     """
 
     def __init__(self, broker, metrics, *, hooks=None, telemetry=None,
-                 n_lanes: int = 4, depth: int = 8):
+                 n_lanes: int = 4, depth: int = 8, supervisor=None):
         self.broker = broker
         self.metrics = metrics
         self.hooks = hooks
         self.telemetry = telemetry
+        # fault-domain supervision (ISSUE 6): the lane_deliver breaker
+        # gates active() (open → the engines deliver inline, the rung
+        # below the lanes), slice faults are contained + retried, dead
+        # workers are restarted by the drain/admit watchdogs. None
+        # restores the pre-ISSUE-6 behavior exactly.
+        self.sup = supervisor
         self.n_lanes = n_lanes
         # max outstanding PLANS (consumed sub-batches) before admit()
         # blocks the batcher's consumer — the backpressure bound
@@ -513,7 +519,18 @@ class DeliveryLanePool:
 
     # ---- lifecycle ------------------------------------------------------
     def active(self) -> bool:
-        return self.n_lanes > 0
+        if self.n_lanes <= 0:
+            return False
+        if self.sup is None or self.sup.lanes_enabled():
+            return True
+        # lane_deliver breaker open: stop taking NEW plans only once the
+        # in-flight lane work has drained — an immediate inline fallback
+        # could deliver a session's newer message while its older rows
+        # are still queued on a lane (per-session FIFO violation). Plans
+        # admitted here still ride the ordered lane queues; the
+        # consumer's windows are sequential, so once busy() goes false
+        # the lanes are empty and the inline fallback is order-safe.
+        return self.busy()
 
     def ensure_loop(self) -> bool:
         """(Re)start the workers on the CURRENT running loop. Tests run
@@ -546,7 +563,10 @@ class DeliveryLanePool:
         for i in range(self.n_lanes):
             w = self._workers[i]
             if w is None or w.done():
-                self._workers[i] = loop.create_task(self._worker(i))
+                from emqx_tpu.broker.supervise import guard_task
+                self._workers[i] = guard_task(
+                    loop.create_task(self._worker(i)),
+                    f"deliver-lane{i}", self.metrics)
         return True
 
     def pause(self) -> None:
@@ -658,7 +678,7 @@ class DeliveryLanePool:
         self.metrics.inc("pipeline.deliver.backpressure_waits")
         while self._live_plans > self.depth:
             self._wake.clear()
-            await self._wake.wait()
+            await self._wait_wake()
 
     async def drain(self) -> None:
         """Wait for every outstanding plan to finish delivering. Host-
@@ -668,9 +688,57 @@ class DeliveryLanePool:
         extends through the lanes)."""
         if self._wake is None:
             return
+        if self._loop is not asyncio.get_running_loop():
+            # drain on a NEW loop (tests tear loops down under a live
+            # node): rebind first — ensure_loop force-finalizes plans
+            # stranded on the dead loop, releasing their pinned
+            # snapshot handles, so this drain returns instead of
+            # waiting forever on a wake event nobody can set
+            self.ensure_loop()
         while self._live_plans > 0:
             self._wake.clear()
+            await self._wait_wake()
+
+    async def _wait_wake(self) -> None:
+        """One bounded wait on lane progress. With a supervisor
+        (ISSUE 6) the wait is a lane-queue watchdog: a deadline expiry
+        counts a stall, RESTARTS any dead lane workers (their queues
+        are intact, so a revived worker drains in order — the
+        crashed-lane recovery contract) and advances the lane_deliver
+        breaker, instead of wedging the caller forever on a queue
+        nobody is consuming."""
+        sup = self.sup
+        if sup is None:
             await self._wake.wait()
+            return
+        try:
+            await asyncio.wait_for(self._wake.wait(),
+                                   sup.deadline("lane_deliver"))
+        except asyncio.TimeoutError:
+            sup.note_stall("lane_deliver")
+            if self._revive_workers():
+                sup.note_restart("lane_worker")
+
+    def _revive_workers(self) -> int:
+        """Restart dead lane workers on the current loop (the stall
+        watchdog's recovery arm; ensure_loop does the same lazily at
+        the next plan intake). Queues are untouched — a restarted
+        worker picks up exactly where the dead one stopped, in order."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return 0
+        if loop is not self._loop:
+            return 0
+        revived = 0
+        from emqx_tpu.broker.supervise import guard_task
+        for i, w in enumerate(self._workers):
+            if w is None or w.done():
+                self._workers[i] = guard_task(
+                    loop.create_task(self._worker(i)),
+                    f"deliver-lane{i}", self.metrics)
+                revived += 1
+        return revived
 
     def busy(self) -> bool:
         return self._live_plans > 0
@@ -711,44 +779,124 @@ class DeliveryLanePool:
                 if self._live_plans == 0 and q.empty():
                     return
                 continue
-            if not self._gate.is_set():
-                await self._gate.wait()
             t0 = time.perf_counter()
             worked = True
-            if item[0] == "slice":
-                _k, plan, lo, hi = item
-                try:
-                    await self._run_slice(plan, lane, lo, hi)
-                finally:
-                    plan._finish_part()
-            else:  # barrier
-                _k, plan = item
-                plan._barrier_left -= 1
-                if plan._barrier_left == 0:
+            try:
+                if not self._gate.is_set():
                     try:
-                        await self._run_slow(plan)
+                        await self._gate.wait()
+                    except asyncio.CancelledError:
+                        # dying while HOLDING a popped item: surrender
+                        # it (lost-but-accounted) or its plan's part
+                        # leaks and every future drain wedges on work
+                        # nobody owns — the gap the ISSUE-6 lane
+                        # watchdog test exposed
+                        self._surrender(item)
+                        raise
+                t0 = time.perf_counter()   # gate wait is not lane work
+                if item[0] == "slice":
+                    _k, plan, lo, hi = item
+                    try:
+                        try:
+                            if self.sup is not None:
+                                # ISSUE 6 injection point: a lane
+                                # worker failing mid-slice must be
+                                # contained, not a silent task death
+                                self.sup.fire("lane_deliver")
+                            await self._run_slice(plan, lane, lo, hi)
+                            if self.sup is not None:
+                                self.sup.note_ok("lane_deliver")
+                        except Exception as e:  # noqa: BLE001
+                            if self.sup is None:
+                                raise   # pre-ISSUE-6: the task dies
+                            # real delivery faults are contained PER
+                            # CHUNK inside _run_slice; reaching here
+                            # means the slice failed BEFORE any
+                            # delivery (the injection point, chunk-
+                            # boundary code), so a whole-slice retry
+                            # cannot duplicate
+                            self.sup.note_fault("lane_deliver", e)
+                            try:
+                                # re-run CHUNKED (cooperative yields) —
+                                # one flat _deliver_rows over a huge
+                                # slice would monopolize the loop, the
+                                # exact stall the chunking prevents
+                                await self._run_slice(plan, lane,
+                                                      lo, hi)
+                            except Exception:  # noqa: BLE001
+                                log.exception(
+                                    "lane %d slice %d..%d lost after "
+                                    "retry", lane, lo, hi)
+                                self.metrics.inc(
+                                    "pipeline.deliver.deliver_errors")
                     finally:
-                        plan._barrier_evt.set()
                         plan._finish_part()
-                else:
-                    # waiting out another lane's slow tail is not THIS
-                    # lane's work: recording it would read as uniform
-                    # slowness and mask real per-lane hashing skew in
-                    # the deliver_lane{i} histograms
-                    worked = False
-                    await plan._barrier_evt.wait()
-            self._lane_items[lane] -= 1
+                else:  # barrier
+                    _k, plan = item
+                    plan._barrier_left -= 1
+                    if plan._barrier_left == 0:
+                        try:
+                            await self._run_slow(plan)
+                        finally:
+                            plan._barrier_evt.set()
+                            plan._finish_part()
+                    else:
+                        # waiting out another lane's slow tail is not
+                        # THIS lane's work: recording it would read as
+                        # uniform slowness and mask real per-lane
+                        # hashing skew in the deliver_lane{i}
+                        # histograms
+                        worked = False
+                        await plan._barrier_evt.wait()
+            finally:
+                # gauge accounting must survive cancellation anywhere
+                # in the item's processing (mid-slice, barrier wait) or
+                # lane_depth overreports a stuck-deep lane forever
+                self._lane_items[lane] -= 1
             if tele is not None and worked:
                 tele.observe_stage(f"deliver_lane{lane}",
                                    time.perf_counter() - t0)
+
+    def _surrender(self, item) -> None:
+        """Account a popped-but-unprocessed queue item when its worker
+        dies: the plan part is finished so drains can complete (the
+        worker's finally owns the lane-depth gauge decrement). A
+        surrendered slice loses its deliveries (counted as
+        deliver_errors; finalize then books the no-subscriber drops);
+        a surrendered barrier passes this lane through, and the LAST
+        lane's surrender runs the slow closures synchronously (they
+        are plain callables) so their deliveries survive."""
+        if item[0] == "slice":
+            self.metrics.inc("pipeline.deliver.deliver_errors")
+            item[1]._finish_part()
+        elif item[0] == "barrier":
+            plan = item[1]
+            plan._barrier_left -= 1
+            if plan._barrier_left == 0:
+                for idx, fn in plan.slow_items:
+                    try:
+                        plan.counts[idx] = fn()
+                    except Exception:  # noqa: BLE001 — death path
+                        self.metrics.inc("pipeline.deliver.slow_errors")
+                if plan._barrier_evt is not None:
+                    plan._barrier_evt.set()
+                plan._finish_part()
 
     async def _run_slice(self, plan: DeliveryPlan, lane: int,
                          lo: int, hi: int) -> None:
         """Deliver one lane's slice, coalescing same-session runs, with
         a cooperative yield between chunks so a huge fan-out cannot
         monopolize the loop (other lanes and the producer keep running;
-        later plans queue behind this one per-lane, so order holds)."""
+        later plans queue behind this one per-lane, so order holds).
+
+        Fault containment is PER CHUNK (ISSUE 6): a raising chunk is
+        retried once, and only that chunk — retrying the whole slice
+        would re-deliver (and double-count) the chunks that already
+        succeeded. Counts apply only on a chunk's successful return, so
+        a retried chunk is at-least-once for its subscribers but never
+        double-counted toward the publisher."""
         sids = plan.s_sid
+        sup = self.sup
         pos = lo
         while pos < hi:
             nxt = min(hi, pos + self._chunk)
@@ -756,7 +904,20 @@ class DeliveryLanePool:
             # coalesced drain and its all-or-none accept are per run
             while nxt < hi and sids[nxt] == sids[nxt - 1]:
                 nxt += 1
-            self._deliver_rows(plan, pos, nxt)
+            if sup is None:
+                self._deliver_rows(plan, pos, nxt)
+            else:
+                try:
+                    self._deliver_rows(plan, pos, nxt)
+                except Exception as e:  # noqa: BLE001 — contained
+                    sup.note_fault("lane_deliver", e)
+                    try:
+                        self._deliver_rows(plan, pos, nxt)
+                    except Exception:  # noqa: BLE001
+                        log.exception("lane %d chunk %d..%d lost "
+                                      "after retry", lane, pos, nxt)
+                        self.metrics.inc(
+                            "pipeline.deliver.deliver_errors")
             pos = nxt
             if pos < hi:
                 await asyncio.sleep(0)

@@ -484,7 +484,8 @@ class DeviceRouteEngine:
                  match_cache_size: Optional[int] = None,
                  dedup: Optional[bool] = None,
                  compact_readback: Optional[bool] = None,
-                 delta_overlay: Optional[bool] = None):
+                 delta_overlay: Optional[bool] = None,
+                 supervisor=None):
         self.node = node
         self.broker = node.broker
         self.router = node.broker.router
@@ -595,6 +596,18 @@ class DeviceRouteEngine:
         self._touched: set[str] = set()
         self._built_deleted: set[str] = set()  # snapshot tombstones
         self._enc_cache: dict[str, list] = {}  # filter -> interned words
+
+        # fault-domain supervision (ISSUE 6): injection points at every
+        # stage boundary, breaker-gated degradation (the reuse layers
+        # stand down at rung 1, the whole device path at rung 2 — the
+        # batcher reads the rung), contained cache/overlay/swap faults.
+        # None (knob off) restores the pre-ISSUE-6 unwind exactly.
+        self.sup = supervisor if supervisor is not None \
+            else getattr(node, "supervisor", None)
+        if self.sup is not None:
+            self.sup.register_probe("dispatch", self._probe_dispatch)
+            self.sup.register_probe("materialize",
+                                    self._probe_materialize)
 
         # wire change notifications
         self.router.on_route_change = self.note_route_change
@@ -1033,6 +1046,12 @@ class DeviceRouteEngine:
     def _apply_build(self, result, journal) -> None:
         """Swap a finished build in and rebase churn tracking onto it by
         replaying the journal of mutations that happened during the build."""
+        if self.sup is not None:
+            # ISSUE 6 injection point: a swap failure is contained by
+            # _try_swap / poll_rebuild — serving stays on the old
+            # snapshot + host deltas (whose churn tracking is still
+            # current: journaled note_* calls also ran live against it)
+            self.sup.fire("snapshot_swap")
         self._reset_deltas()
         if result is None:
             self._built = None
@@ -1144,12 +1163,30 @@ class DeviceRouteEngine:
         (_compaction_reason) runs double-buffered in the background."""
         if self._building:
             return
+        if self.sup is not None:
+            # supervision tick rides the batch cadence like the rebuild
+            # policy: launch any due half-open probes (off-path)
+            self.sup.poll()
         if self._built is None:
             n = len(self.router.exact) + len(self.router.wildcards)
             if n == 0:
                 return
+            if self.sup is not None and not self.sup.rebuild_enabled():
+                return      # swap breaker open: host-route until probed
             if n <= 4096 or not self.maybe_background_rebuild():
-                self.rebuild()
+                if self.sup is None:
+                    self.rebuild()
+                    return
+                try:
+                    self.rebuild()
+                except Exception as e:  # noqa: BLE001 — contained
+                    # first-build fault (ISSUE 6): serving stays
+                    # host-side (no snapshot → prepare returns None)
+                    # until the snapshot_swap breaker's probe re-admits
+                    # rebuild attempts
+                    self.sup.note_fault("snapshot_swap", e)
+                    self.node.metrics.inc(
+                        "routing.device.rebuild_failed")
         else:
             reason = self._compaction_reason()
             if reason is not None and self.maybe_background_rebuild():
@@ -1162,6 +1199,11 @@ class DeviceRouteEngine:
         import asyncio
         if self._building:
             return True
+        if self.sup is not None and not self.sup.rebuild_enabled():
+            # snapshot_swap breaker open (ISSUE 6): no rebuild attempts
+            # until the half-open probe succeeds — the old snapshot +
+            # host deltas keep serving correctly meanwhile
+            return False
         if self._built is not None \
                 and self._compaction_reason() is None:
             return False
@@ -1174,8 +1216,10 @@ class DeviceRouteEngine:
             return False
         self._building = True
         self._journal = []
-        self._rebuild_task = loop.create_task(
-            self._background_rebuild(executor))
+        from emqx_tpu.broker.supervise import guard_task
+        self._rebuild_task = guard_task(
+            loop.create_task(self._background_rebuild(executor)),
+            "device-rebuild", self.node.metrics)
         return True
 
     async def _background_rebuild(self, executor=None) -> None:
@@ -1270,8 +1314,62 @@ class DeviceRouteEngine:
         self._journal = None
         self._building = False
         t0 = time.perf_counter()
-        self._apply_build(result, journal)
+        if self.sup is None:
+            self._apply_build(result, journal)
+        else:
+            try:
+                self._apply_build(result, journal)
+            except Exception as e:  # noqa: BLE001 — contained domain
+                # swap fault (ISSUE 6): the old snapshot keeps serving
+                # (its dirty/delta tracking ran live during the build,
+                # so dropping the failed result loses nothing); the
+                # breaker gates further rebuild attempts until a probe
+                self.sup.note_fault("snapshot_swap", e)
+                self.node.metrics.inc("routing.device.rebuild_failed")
+                self._observe_rebuild("swap", t0)
+                return
+            self.sup.note_ok("snapshot_swap")
         self._observe_rebuild("swap", t0)
+
+    # ---- supervision probes (ISSUE 6: off-the-serving-path health
+    #      checks the half-open breaker runs on an executor thread) ----
+    def _probe_dispatch(self) -> None:
+        """End-to-end health check of the dispatch stage: run the plain
+        route program over an all-pad batch against the live tables —
+        the same shape the demand-warm calls already execute from
+        executor threads, so thread-safety and jit-cache behavior are
+        identical. Matches nothing, advances nothing (the probe's
+        new_cursors are dropped; an all-pad batch has zero occur)."""
+        if self._built is None or self._tables is None:
+            return      # nothing to probe: vacuous health
+        import jax
+
+        from emqx_tpu.models import router_engine as RE
+        from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
+        Bp = self._STD_CLASSES[0][1]
+        enc = np.zeros((1, Bp, self.max_levels), np.int32)
+        z = np.zeros((1, Bp), np.int32)
+        zb = np.zeros((1, Bp), bool)
+        strat = np.int32(STRATEGY_ROUND_ROBIN)
+        if self._built.backend == "shapes":
+            r = RE.route_window_full(self._tables, self._cursors, enc,
+                                     z, zb, z, strat,
+                                     fanout_cap=self.fanout_cap,
+                                     slot_cap=self.slot_cap)
+        else:
+            r = RE.route_step(self._tables, self._cursors, enc[0], z[0],
+                              zb[0], z[0], strat,
+                              frontier_cap=self.frontier_cap,
+                              match_cap=self.match_cap,
+                              fanout_cap=self.fanout_cap,
+                              slot_cap=self.slot_cap)
+        jax.block_until_ready(r.match_counts)
+
+    def _probe_materialize(self) -> None:
+        """Health check of the readback stage: one small device→host
+        transfer proves the link."""
+        import jax.numpy as jnp
+        np.asarray(jnp.zeros((8,), jnp.int32))
 
     # ---- the serving path ----------------------------------------------
     def device_shared_active(self) -> bool:
@@ -1311,17 +1409,39 @@ class DeviceRouteEngine:
         return max(64, Bp)
 
     def _overlay_sync(self) -> None:
-        """Apply pending journal entries to the overlay: rebuild the
-        small host table from the live delta dicts and upload a fresh
-        DeltaTables version. The table is a few hundred rows of numpy —
-        microseconds, safe on the loop; the EXPENSIVE part (the fused
-        program compile for a new row class) is demand-warmed off the
-        serving path like the cached/compact ladders (_gate_delta).
-        Versions are immutable: in-flight handles keep the table they
-        dispatched with, and per-fid membership staleness is judged
-        against the pinned version's clock stamp at consume."""
+        """Apply pending journal entries to the overlay (see
+        _overlay_sync_inner for the mechanics). Under supervision
+        (ISSUE 6) this is the overlay_apply fault domain: a raising
+        apply is CONTAINED — the overlay stays stale and its filters
+        serve through the host delta trie (exactly the pre-overlay
+        fallback, counted by routing.device.host_delta) while the
+        breaker opens toward rung 1. Without supervision the exception
+        propagates out of prepare (the pre-ISSUE-6 behavior: the whole
+        group host-routes via the batcher's produce catch)."""
         if not self.delta_overlay or not self._overlay_stale:
             return
+        sup = self.sup
+        if sup is None:
+            self._overlay_sync_inner()
+            return
+        try:
+            sup.fire("overlay_apply")
+            self._overlay_sync_inner()
+        except Exception as e:  # noqa: BLE001 — contained fault domain
+            sup.note_fault("overlay_apply", e)
+        else:
+            sup.note_ok("overlay_apply")
+
+    def _overlay_sync_inner(self) -> None:
+        """Rebuild the small host table from the live delta dicts and
+        upload a fresh DeltaTables version. The table is a few hundred
+        rows of numpy — microseconds, safe on the loop; the EXPENSIVE
+        part (the fused program compile for a new row class) is
+        demand-warmed off the serving path like the cached/compact
+        ladders (_gate_delta). Versions are immutable: in-flight
+        handles keep the table they dispatched with, and per-fid
+        membership staleness is judged against the pinned version's
+        clock stamp at consume."""
         t0 = time.perf_counter()
         from emqx_tpu.ops.delta import build_delta_tables
         live = sorted(self._delta_filter.items())   # fid order = age
@@ -1989,7 +2109,10 @@ class DeviceRouteEngine:
             finally:
                 self._fuse_warm_task = None
 
-        self._fuse_warm_task = loop.create_task(run())
+        from emqx_tpu.broker.supervise import guard_task
+        self._fuse_warm_task = guard_task(loop.create_task(run()),
+                                          "device-class-warm",
+                                          self.node.metrics)
 
 
     def prepare_window(self, lives: list[list[Message]],
@@ -2049,16 +2172,21 @@ class DeviceRouteEngine:
         h = _Handle(subs, b, self.device_shared_active())
         h.enc = (enc4, len4, dol4)
         seq_trie = b.backend != "shapes" and Wp > 1
-        if not seq_trie:
+        # degradation ladder rung 1 (ISSUE 6): with the cache_insert or
+        # overlay_apply breaker open, the reuse layers stand down and
+        # this window dispatches the PLAIN program — device-plain is
+        # the middle rung between full-featured and host-trie
+        degraded = self.sup is not None and not self.sup.reuse_enabled()
+        if not seq_trie and not degraded:
             # delta overlay for this dispatch (None = host fallback for
             # post-snapshot filters, exactly the pre-overlay behavior).
             # The sequential multi-batch trie window has no single fused
             # program to hang the overlay on — rare direct-caller path.
             h.delta = self._gate_delta(Wp, Bp, gate_cold)
-        if self.dedup:
+        if self.dedup and not degraded:
             h.plan, h.cache_info = self._plan_window(b, enc4, len4, dol4,
                                                      gate_cold, h.delta)
-        if not (seq_trie and h.plan is None):
+        if not degraded and not (seq_trie and h.plan is None):
             # CSR readback class for this dispatch (None = dense). The
             # excluded case is the rare plain multi-batch trie window,
             # which dispatches sequential steps and stacks host-side —
@@ -2160,9 +2288,24 @@ class DeviceRouteEngine:
         step/window, with up to three optional fused dimensions — dedup
         plan (ISSUE 2), CSR readback (ISSUE 3), delta overlay
         (ISSUE 4) — each independently warm-gated at prepare."""
+        if self.sup is not None:
+            # ISSUE 6 injection point: an exception here propagates to
+            # the batcher's consumer, which notes the fault, replays the
+            # window host-side and advances the dispatch breaker; a hang
+            # is caught by the consumer's watchdog deadline
+            self.sup.fire("dispatch")
         from emqx_tpu.models import router_engine as RE
         from emqx_tpu.ops.shared import (STRATEGIES, STRATEGY_ROUND_ROBIN)
         broker = self.broker
+        # pin the table/cursor pair ONCE for this whole dispatch: a
+        # watchdog timeout (ISSUE 6) abandons the handle while this
+        # thread is still running, which releases the swap gate — a
+        # zombie dispatch must neither mix old and new tables mid-call
+        # nor clobber the new snapshot's cursors with a late write (the
+        # identity guard at the end, mirroring the mesh's `_builts is
+        # h.built` discipline in parallel/serving.py)
+        tables, cursors = self._tables, self._cursors
+        sig = self._cur_sig
         enc4, len4, dol4 = h.enc
         Wp, Bp = enc4.shape[0], enc4.shape[1]
         strat_id = STRATEGIES.get(broker.shared_strategy,
@@ -2192,11 +2335,13 @@ class DeviceRouteEngine:
             import jax.numpy as jnp
             outs = []
             for k in range(Wp):
-                r = RE.route_step(self._tables, self._cursors, enc4[k],
+                r = RE.route_step(tables, cursors, enc4[k],
                                   len4[k], dol4[k], msg_hash[k], strat,
                                   **kw)
-                self._cursors = r.new_cursors
+                cursors = r.new_cursors
                 outs.append(r)
+            if self._tables is tables:   # no swap raced this dispatch
+                self._cursors = cursors
             h.res = type(outs[0])(*[jnp.stack([getattr(o, f)
                                               for o in outs])
                                     for f in outs[0]._fields])
@@ -2219,17 +2364,17 @@ class DeviceRouteEngine:
                       else RE.route_window_delta_cached) if shapes else \
                     (RE.route_step_delta_cached_compact if P is not None
                      else RE.route_step_delta_cached)
-                out = fn(self._tables, ov.dev, self._cursors, *base,
+                out = fn(tables, ov.dev, cursors, *base,
                          *dbase, *tail, **kw, **dkw, **ckw)
             else:
                 fn = (RE.route_window_cached_compact if P is not None
                       else RE.route_window_cached) if shapes else \
                     (RE.route_step_cached_compact if P is not None
                      else RE.route_step_cached)
-                out = fn(self._tables, self._cursors, *base, *tail,
+                out = fn(tables, cursors, *base, *tail,
                          **kw, **ckw)
             self.node.metrics.inc("routing.device.cached_windows")
-            warm_key = self._class_key(self._cur_sig, Wp, Bp, Bm=p.Bm,
+            warm_key = self._class_key(sig, Wp, Bp, Bm=p.Bm,
                                        dC=dC, P=P)
         else:
             args4 = (enc4, len4, dol4, msg_hash) if shapes else \
@@ -2239,16 +2384,16 @@ class DeviceRouteEngine:
                       else RE.route_window_delta) if shapes else \
                     (RE.route_step_delta_compact if P is not None
                      else RE.route_step_delta)
-                out = fn(self._tables, ov.dev, self._cursors, *args4,
+                out = fn(tables, ov.dev, cursors, *args4,
                          strat, **kw, **dkw, **ckw)
             else:
                 fn = (RE.route_window_full_compact if P is not None
                       else RE.route_window_full) if shapes else \
                     RE.route_step_compact   # plain trie without P
                                             # returned above
-                out = fn(self._tables, self._cursors, *args4, strat,
+                out = fn(tables, cursors, *args4, strat,
                          **kw, **ckw)
-            warm_key = self._class_key(self._cur_sig, Wp, Bp, dC=dC,
+            warm_key = self._class_key(sig, Wp, Bp, dC=dC,
                                        P=P)
 
         # unwrap the result family; every remaining variant is
@@ -2270,7 +2415,8 @@ class DeviceRouteEngine:
                 import jax.numpy as jnp
                 res = type(res)(*[jnp.stack([getattr(res, f)])
                                   for f in res._fields])
-        self._cursors = res.new_cursors[-1]
+        if self._tables is tables:   # no swap raced this dispatch
+            self._cursors = res.new_cursors[-1]
         self._warm_classes.add(warm_key)
         h.res = res
 
@@ -2363,6 +2509,16 @@ class DeviceRouteEngine:
         tele = getattr(self.node, "pipeline_telemetry", None)
         metrics = self.node.metrics
         t0 = time.perf_counter()
+        corrupt = None
+        if self.sup is not None:
+            # ISSUE 6 injection point (executor thread): exceptions
+            # propagate to the consumer (fault noted + window replayed
+            # host-side), hangs are caught by its watchdog deadline,
+            # and "corrupt" shape-corrupts the readback below — the
+            # consume stage then blows up exactly like a real
+            # wrong-shape transfer would, and the supervisor's replay
+            # path must recover the window
+            corrupt = self.sup.fire("materialize", corrupt_ok=True)
         res = h.res
         cp = h.cres
         delta_bytes = self._materialize_delta(h)
@@ -2411,8 +2567,10 @@ class DeviceRouteEngine:
                         items.append((key, (row, cm, bool(o_flat[lane]))
                                       + self._delta_cache_fields(h, lane,
                                                                  Bp)))
-                    self._match_cache.put_many(info.sid, items,
-                                               version=info.version)
+                    self._cache_put(info.sid, items,
+                                    version=info.version)
+                if corrupt:
+                    self._corrupt_readback(h)
                 if tele is not None:
                     tele.observe_stage("materialize",
                                        time.perf_counter() - t0)
@@ -2440,15 +2598,53 @@ class DeviceRouteEngine:
             # all three are pure functions of (snapshot, topic), and
             # post_match re-ORs the fan-out/slot parts, so the merged
             # result stays bit-identical to a cold match
-            self._match_cache.put_many(
+            self._cache_put(
                 info.sid,
                 [(k, (mflat[i].copy(), int(cflat[i]), bool(oflat[i]))
                   + self._delta_cache_fields(h, i, Bp))
                  for k, i in info.inserts], version=info.version)
         metrics.inc("pipeline.readback.bytes.dense", dense_bytes)
         metrics.inc("pipeline.readback.windows.dense")
+        if corrupt:
+            self._corrupt_readback(h)
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
+
+    def _corrupt_readback(self, h) -> None:
+        """Apply the injected corrupt-shape fault: truncate the window
+        axis of the host views so the consume stage fails exactly like
+        a real wrong-shape readback (an IndexError at the first plane
+        access) — the supervisor's window replay must then re-route the
+        window host-side with zero loss."""
+        nr = h.np_res
+        if isinstance(nr, _CsrRes):
+            h.np_res = _CsrRes(nr.off[:0], nr.c3[:0], nr.pay[:0],
+                               nr.overflow[:0], nr.occur[:0])
+        elif nr is not None:
+            h.np_res = tuple(a[:0] for a in nr)
+
+    def _cache_put(self, sid, items, version=None) -> None:
+        """Match-cache population with the cache_insert fault domain
+        (ISSUE 6): under supervision a raising insert is CONTAINED —
+        the cache is an optimization, so a cache bug must cost the
+        reuse layer (breaker opens → rung 1, plain dispatches), never
+        the window. Without supervision the exception propagates out of
+        materialize exactly as before (dispatch_failed → host
+        fallback)."""
+        cache = self._match_cache
+        if cache is None:
+            return
+        sup = self.sup
+        if sup is None:
+            cache.put_many(sid, items, version=version)
+            return
+        try:
+            sup.fire("cache_insert")
+            cache.put_many(sid, items, version=version)
+        except Exception as e:  # noqa: BLE001 — contained fault domain
+            sup.note_fault("cache_insert", e)
+        else:
+            sup.note_ok("cache_insert")
 
     def finish_sub(self, h, k: int, defer: bool = True) -> list[int]:
         """Stage 4 (event loop): consume sub-batch k of the window into

@@ -64,6 +64,24 @@ class Node:
                                          False))
         self.device_engine = None
         self.publish_batcher = None
+        # fault-domain supervision (ISSUE 6): the per-node supervision
+        # tree every pipeline stage plugs into — fault injection points,
+        # per-stage circuit breakers driving the degradation ladder
+        # (device+cache+delta → device-plain → host-trie), the window
+        # journal and the stage watchdogs. broker.supervise /
+        # EMQX_TPU_SUPERVISE =0 restores the pre-ISSUE-6 ad-hoc unwind
+        # behavior exactly (self.supervisor stays None everywhere).
+        self.supervisor = None
+        from emqx_tpu.broker.supervise import (PipelineSupervisor,
+                                               resolve_supervise)
+        mc = perf.get("multichip") or {}
+        if resolve_supervise(perf.get("supervise")) \
+                and (use_device or mc.get("enable")):
+            self.supervisor = PipelineSupervisor(
+                self.metrics, telemetry=self.pipeline_telemetry,
+                threshold=perf.get("supervise_threshold"))
+            self.pipeline_telemetry.supervise_state_fn = \
+                self.supervisor.state
         # session-affine delivery lanes (ISSUE 5): the overlapped egress
         # stage both engines' consume hands plans to. 0 lanes (config
         # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores
@@ -72,12 +90,12 @@ class Node:
         from emqx_tpu.broker.deliver import (DeliveryLanePool,
                                              resolve_deliver_lanes)
         n_lanes = resolve_deliver_lanes(perf.get("deliver_lanes"))
-        mc = perf.get("multichip") or {}
         if n_lanes > 0 and (use_device or mc.get("enable")):
             self.deliver_lanes = DeliveryLanePool(
                 self.broker, self.metrics, hooks=self.hooks,
                 telemetry=self.pipeline_telemetry, n_lanes=n_lanes,
-                depth=perf.get("deliver_lane_depth", 8))
+                depth=perf.get("deliver_lane_depth", 8),
+                supervisor=self.supervisor)
             self.pipeline_telemetry.deliver_state_fn = \
                 self.deliver_lanes.state
             self.stats.register_stats_fun(self.deliver_lanes.stats_fun)
@@ -96,7 +114,8 @@ class Node:
                 # churn knob (ISSUE 4): the mesh's churn path is already
                 # incremental (per-shard compaction) — the knob is
                 # accepted for config parity and surfaced in stats
-                delta_overlay=perf.get("delta_overlay"))
+                delta_overlay=perf.get("delta_overlay"),
+                supervisor=self.supervisor)
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
@@ -119,7 +138,8 @@ class Node:
                 compact_readback=perf.get("compact_readback"),
                 # delta-overlay A/B knob (ISSUE 4; None =
                 # EMQX_TPU_DELTA_OVERLAY / default-on)
-                delta_overlay=perf.get("delta_overlay"))
+                delta_overlay=perf.get("delta_overlay"),
+                supervisor=self.supervisor)
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
@@ -283,8 +303,10 @@ class Node:
 
     def start_timers(self, interval: float = 1.0) -> None:
         if self._timer_task is None:
-            self._timer_task = asyncio.ensure_future(
-                self._housekeeping(interval))
+            from emqx_tpu.broker.supervise import guard_task
+            self._timer_task = guard_task(
+                asyncio.ensure_future(self._housekeeping(interval)),
+                "node-housekeeping", self.metrics)
 
     def stop_timers(self) -> None:
         if self._timer_task is not None:

@@ -233,6 +233,8 @@ class Broker:
             return
         self._pub_tasks.add(task)
         task.add_done_callback(self._pub_tasks.discard)
+        from emqx_tpu.broker.supervise import guard_task
+        guard_task(task, "publish-soon", self.metrics)
 
     def publish_batch(self, msgs: list[Message]) -> list[int]:
         """Micro-batched publish: one device route step for the whole batch

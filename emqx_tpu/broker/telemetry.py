@@ -126,6 +126,10 @@ class PipelineTelemetry:
         # live delivery-lane gauges provider (set by the node when the
         # ISSUE-5 DeliveryLanePool exists): lane depth, live plans
         self.deliver_state_fn = None
+        # live supervision gauges provider (set by the node when the
+        # ISSUE-6 PipelineSupervisor exists): breaker states, ladder
+        # rung, window-journal depth, armed fault clauses
+        self.supervise_state_fn = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -392,6 +396,32 @@ class PipelineTelemetry:
                 deliver["state"] = self.deliver_state_fn()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # fault-domain supervision (ISSUE 6): fault/trip/replay/stall
+        # counters + the supervisor's live breaker/rung/journal state —
+        # the section the chaos matrix and the OBSERVABILITY triage
+        # order read first when a pipeline degrades
+        supervise = {}
+        for k in ("faults", "trips", "probes", "probe_failures",
+                  "replays", "stalls", "restarts", "task_errors",
+                  "rung_changes"):
+            v = self.metrics.val(f"supervise.{k}")
+            if v:
+                supervise[k] = v
+        by_point = {k.rsplit(".", 1)[1]: v
+                    for k, v in self.metrics.all().items()
+                    if k.startswith("supervise.faults.")}
+        if by_point:
+            supervise["faults_by_point"] = by_point
+        by_stall = {k.rsplit(".", 1)[1]: v
+                    for k, v in self.metrics.all().items()
+                    if k.startswith("supervise.stalls.")}
+        if by_stall:
+            supervise["stalls_by_stage"] = by_stall
+        if self.supervise_state_fn is not None:
+            try:
+                supervise["state"] = self.supervise_state_fn()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -399,6 +429,8 @@ class PipelineTelemetry:
             "compiles": compiles,
             "decisions": decisions,
         }
+        if supervise:
+            out["supervise"] = supervise
         if rebuild:
             out["rebuild"] = rebuild
         if deliver:

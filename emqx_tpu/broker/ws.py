@@ -220,7 +220,9 @@ class WsListener:
         pipe = asyncio.StreamReader()
         ws_writer = _WsWriter(writer)
         conn = Connection(self.node, pipe, ws_writer, zone=self.zone)
-        conn_task = asyncio.ensure_future(conn.run())
+        from emqx_tpu.broker.supervise import guard_task
+        conn_task = guard_task(asyncio.ensure_future(conn.run()),
+                               "ws-conn", self.node.metrics)
         fragments: list[bytes] = []
         frag_op = OP_BIN
         try:

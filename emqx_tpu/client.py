@@ -308,6 +308,6 @@ class Client:
             self._writer.close()
             try:
                 await self._writer.wait_closed()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — best-effort close: the
+                pass           # peer may already have reset the socket
         self.closed.set()

@@ -20,6 +20,8 @@ as {"$b": base64}. Messages:
 from __future__ import annotations
 
 import asyncio
+
+from emqx_tpu.broker.supervise import spawn
 import base64
 import json
 import logging
@@ -309,9 +311,9 @@ class RpcNode:
                     break
                 t = msg.get("t")
                 if t == "call":
-                    asyncio.create_task(self._run_call(writer, msg))
+                    spawn(self._run_call(writer, msg), "rpc-call")
                 elif t == "cast":
-                    asyncio.create_task(self._run_cast(msg))
+                    spawn(self._run_cast(msg), "rpc-cast")
         finally:
             self._inbound.discard(writer)
             try:
@@ -367,7 +369,8 @@ class RpcNode:
             # channels in the background
             del self.peers[node]
             try:
-                asyncio.get_running_loop().create_task(cur.close())
+                asyncio.get_running_loop()
+                spawn(cur.close(), "rpc-pool-close")
             except RuntimeError:          # no loop (sync test context)
                 for ch in cur.channels:
                     if ch.writer is not None:

@@ -18,6 +18,8 @@ per-origin sequence number discards out-of-order/duplicate casts.
 from __future__ import annotations
 
 import asyncio
+
+from emqx_tpu.broker.supervise import spawn
 import logging
 import time
 from typing import Any, Callable, Optional
@@ -289,7 +291,8 @@ class ClusterStore:
             # resync that origin's current state (it may have mutated while
             # partitioned — the autoheal path)
             try:
-                asyncio.get_running_loop().create_task(self._safe_sync(node))
+                asyncio.get_running_loop()
+                spawn(self._safe_sync(node), "store-resync")
             except RuntimeError:
                 pass   # no loop (sync test context): peer syncs on join
 

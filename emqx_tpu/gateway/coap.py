@@ -235,7 +235,8 @@ class CoapGateway(asyncio.DatagramProtocol):
             if msg.type == RST:
                 self._cancel_all(addr)
             return
-        asyncio.ensure_future(self._handle(addr, msg))
+        from emqx_tpu.broker.supervise import spawn
+        spawn(self._handle(addr, msg), "coap-handle")
 
     def _reply(self, addr, req: CoapMessage, rcode: int,
                options: Optional[list] = None,

@@ -153,7 +153,8 @@ class Lwm2mSession:
             cmd = json.loads(msg.payload)
         except ValueError:
             return False
-        asyncio.ensure_future(self.gw.send_command(self, cmd))
+        from emqx_tpu.broker.supervise import spawn
+        spawn(self.gw.send_command(self, cmd), "lwm2m-send-command")
         return True
 
 
@@ -213,7 +214,8 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
             msg = C.decode(data)
         except C.CoapError:
             return
-        asyncio.ensure_future(self._handle(addr, msg))
+        from emqx_tpu.broker.supervise import spawn
+        spawn(self._handle(addr, msg), "lwm2m-handle")
 
     async def _handle(self, addr, msg: C.CoapMessage) -> None:
         cls = msg.code >> 5

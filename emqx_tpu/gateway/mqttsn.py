@@ -210,7 +210,8 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             msg_type, body = decode(data)
         except (IndexError, struct.error):
             return
-        asyncio.ensure_future(self._handle(addr, msg_type, body))
+        from emqx_tpu.broker.supervise import spawn
+        spawn(self._handle(addr, msg_type, body), "mqttsn-handle")
 
     async def _handle(self, addr, msg_type: int, body: bytes) -> None:
         client = self.clients.get(addr)

@@ -112,7 +112,8 @@ class ShardedRouteServer:
                  fanout_cap: int = 128, slot_cap: int = 16,
                  level_cap: int = 16, max_batch: int = 256,
                  compact_readback: Optional[bool] = None,
-                 delta_overlay: Optional[bool] = None):
+                 delta_overlay: Optional[bool] = None,
+                 supervisor=None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -193,6 +194,17 @@ class ShardedRouteServer:
         self._pay_ewma: Optional[float] = None
         self._compact_warm: set[tuple] = set()    # {(Bp, P)}
         self._wanted_pcap: set[tuple] = set()
+
+        # fault-domain supervision (ISSUE 6): the mesh_exchange breaker
+        # gates the whole sharded path (open → prepare_window returns
+        # None → host route, the mesh's rung-2); the injection point
+        # rides dispatch. A mesh fault also advances the batcher's
+        # generic dispatch-stage breaker — both gates fall back to the
+        # same host rung, so double accounting is harmless.
+        self.sup = supervisor if supervisor is not None \
+            else getattr(node, "supervisor", None)
+        if self.sup is not None:
+            self.sup.register_probe("mesh_exchange", self._probe_mesh)
 
         # engine wiring (same hooks DeviceRouteEngine claims)
         self.broker.device_engine = self
@@ -433,8 +445,10 @@ class ShardedRouteServer:
                  for mine in self._bucket_filters()], seen, gen)
             return
         self._capture_gen = gen
-        self._capture_task = loop.create_task(
-            self._capture_then_build(seen, gen))
+        from emqx_tpu.broker.supervise import guard_task
+        self._capture_task = guard_task(
+            loop.create_task(self._capture_then_build(seen, gen)),
+            "mesh-capture", self.node.metrics)
 
     async def _capture_then_build(self, seen, gen: int) -> None:
         import asyncio
@@ -619,6 +633,15 @@ class ShardedRouteServer:
                 jax.block_until_ready(cp.offsets)
             self._compact_warm.add((Bp, P))
 
+    def _probe_mesh(self) -> None:
+        """mesh_exchange half-open probe (ISSUE 6): run the sharded
+        step warm-shaped over an all-pad batch, off the serving path —
+        the same call _warm_one already makes from background threads.
+        Raising keeps the breaker open."""
+        if self._builts is None:
+            return      # nothing to probe: vacuous health
+        self._warm_one(self.n_dp)
+
     def max_fuse(self) -> int:
         return 1        # no window fusion on the mesh path (yet)
 
@@ -641,6 +664,14 @@ class ShardedRouteServer:
         before the mesh can consult the same cache. Until then every
         mesh batch pays the full sharded match, and stats() reports the
         bypass so bench rows can't mistake it for a cold cache."""
+        if self.sup is not None:
+            self.sup.poll()     # supervision tick (probe launcher)
+            if not self.sup.mesh_enabled():
+                # mesh_exchange breaker open (ISSUE 6): the mesh's
+                # rung-2 — every batch host-routes until the half-open
+                # probe (a warm-shaped step off the serving path)
+                # proves the mesh healthy again
+                return None
         if not self.poll_rebuild() or self._builts is None or not lives:
             return None
         from emqx_tpu.ops.match import encode_topics_str
@@ -689,9 +720,23 @@ class ShardedRouteServer:
                 else h.cursors
         ctx = tele.compile_context(f"mesh B{h.enc[0].shape[0]}") \
             if tele is not None else contextlib.nullcontext()
-        with ctx:
-            h.res = self.step(h.tables, cursors, *h.enc,
-                              np.int32(strategy))
+        try:
+            with ctx:
+                if self.sup is not None:
+                    # ISSUE 6 injection point (executor thread): the
+                    # cross-shard exchange — exceptions propagate to
+                    # the batcher's consumer (host replay) with the
+                    # mesh domain noted here; hangs are caught by the
+                    # consumer's watchdog deadline
+                    self.sup.fire("mesh_exchange")
+                h.res = self.step(h.tables, cursors, *h.enc,
+                                  np.int32(strategy))
+        except Exception as e:
+            if self.sup is not None:
+                self.sup.note_fault("mesh_exchange", e)
+            raise
+        if self.sup is not None:
+            self.sup.note_ok("mesh_exchange")
         with self._lock:
             if self._builts is h.built:    # no rebuild raced us
                 self.cursors = h.res.new_cursors

@@ -61,8 +61,8 @@ async def request(method: str, url: str, *,
         writer.close()
         try:
             await writer.wait_closed()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — best-effort close on a one-
+            pass           # shot client socket; the response is in hand
     header_blob, _, rest = raw.partition(b"\r\n\r\n")
     lines = header_blob.decode("latin-1").split("\r\n")
     status = int(lines[0].split()[1])

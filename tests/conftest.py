@@ -25,3 +25,15 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; chaos is the ISSUE-6 deterministic
+    # fault-injection matrix and deliberately NOT slow-marked, so the
+    # injection matrix gates every tier-1 run
+    config.addinivalue_line(
+        "markers", "slow: long-running benchmarks/stress (excluded "
+        "from tier-1)")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection matrix "
+        "(ISSUE 6 supervision layer)")

@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Deterministic chaos harness for the pipeline supervision layer (ISSUE 6).
+
+Drives the REAL serving path (Node → PublishBatcher → device engine →
+delivery lanes) through a deterministic publish schedule while the
+`EMQX_TPU_FAULTS` injection machinery fails one stage at a time, and
+grades the run against the fault-free twin:
+
+- **zero lost QoS≥1 deliveries** — every publish's settled delivery
+  count equals the twin's (the window-journal replay re-routes a dying
+  window through the next ladder rung, it never drops it);
+- **per-session order bit-identical** — each subscriber's delivered
+  (filter, topic) sequence equals the twin's (sessions subscribe one
+  filter each, so the order oracle is path-independent by construction);
+- **degradation within one window** — the stage breaker opens on the
+  faulted window (threshold 1 here) and the ladder steps down;
+- **recovery** — the half-open probe re-closes the breaker once the
+  armed fault clauses are spent.
+
+Run standalone (`python tools/chaos_bench.py`) for the full
+point × kind matrix as one JSON line; tests/test_supervise.py imports
+`run_case`/`run_twin` and asserts the same oracle per combination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from emqx_tpu.broker.message import make                    # noqa: E402
+from emqx_tpu.broker.node import Node                       # noqa: E402
+from emqx_tpu.broker.supervise import (FAULT_KINDS,         # noqa: E402
+                                       FAULT_POINTS, FaultInjector,
+                                       parse_faults)
+
+N_FILTERS = 8
+BATCH = 80          # > 64: the dedup/cache plan analysis engages, so
+                    # the cache_insert point is traversed (Bp = 256)
+WINDOWS = 8
+
+
+class Rec:
+    """Recording sink: per-session delivery log for the order oracle."""
+
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, topic_filter, msg):
+        self.got.append((topic_filter, msg.topic, bytes(msg.payload)))
+        return True
+
+
+def build_node(*, lanes: int = 2, supervise: bool = True,
+               threshold: int = 1) -> Node:
+    return Node({"broker": {
+        "device_fanout_cap": 16, "device_slot_cap": 4,
+        "deliver_lanes": lanes, "device_min_batch": 4,
+        "batch_window_us": 2000, "supervise": supervise,
+        "supervise_threshold": threshold}})
+
+
+def build_world(node: Node, *, with_delta: bool = False) -> dict:
+    """N_FILTERS filters × 2 subscribers (one QoS1). Each session
+    subscribes exactly ONE filter, so its delivered sequence is the
+    publish-order subsequence of its topic — identical across the
+    device/lanes/host paths by construction (the oracle's ground)."""
+    b = node.broker
+    sinks = {}
+    for i in range(N_FILTERS):
+        for q in (0, 1):
+            s = Rec()
+            sid = b.register(s, f"c{i}-{q}")
+            sinks[sid] = s
+            b.subscribe(sid, f"t/{i}/+", {"qos": q})
+    if with_delta:
+        s = Rec()
+        sid = b.register(s, "cd")
+        sinks[sid] = s
+        # subscribed AFTER rebuild by the driver (delta filter)
+        sinks["delta_sid"] = sid
+    return sinks
+
+
+def schedule(windows: int = WINDOWS, batch: int = BATCH) -> list:
+    """Deterministic topic schedule: round-robin over the filters with
+    a unique payload per message."""
+    wins = []
+    seq = 0
+    for _w in range(windows):
+        msgs = []
+        for i in range(batch):
+            msgs.append((f"t/{(seq + i) % N_FILTERS}/x",
+                         b"m%06d" % (seq + i)))
+        seq += batch
+        wins.append(msgs)
+    return wins
+
+
+async def _warm(node: Node) -> None:
+    """Compile the standard batch classes off-path so the batcher's
+    warm gate admits device dispatches deterministically (the chaos
+    clauses must hit the DEVICE path, not a cold-class host detour)."""
+    eng = node.device_engine
+    eng.rebuild()
+    eng._kick_class_warm()
+    if eng._fuse_warm_task is not None:
+        await eng._fuse_warm_task
+
+
+async def _drive(node: Node, wins, *, delta_sub=None,
+                 settle_s: float = 6.0) -> list:
+    """Publish the schedule through the real batcher; after the last
+    window keep publishing single-lane ticks until every armed fault has
+    fired and every breaker re-closed (or `settle_s` elapses)."""
+    counts = []
+    delta_live = False
+    for w, msgs in enumerate(wins):
+        if delta_sub is not None and w == 2:
+            # churn mid-schedule: a post-snapshot (delta) filter —
+            # makes the overlay stale, so the overlay_apply point is
+            # traversed on the next prepare; its topic gets traffic so
+            # the host-delta fallback's zero-loss claim is exercised
+            sid, filt = delta_sub
+            node.broker.subscribe(sid, filt, {"qos": 1})
+            delta_live = True
+        if delta_live:
+            msgs = msgs + [("d/x", b"d%03d" % w)]
+        counts.append(await asyncio.gather(*[
+            node.publish_async(make("pub", 1, t, p)) for t, p in msgs]))
+        await asyncio.sleep(0.02)
+    sup = node.supervisor
+    deadline = time.monotonic() + settle_s
+    while sup is not None and time.monotonic() < deadline:
+        spent = all(f.fired >= f.count for f in sup.injector.faults)
+        closed = all(b.state == "closed"
+                     for b in sup.breakers.values())
+        if spent and closed:
+            break
+        # tick: publishes drive poll_rebuild → sup.poll() → probes
+        counts.append(await asyncio.gather(*[
+            node.publish_async(make("pub", 1, f"t/{i}/x", b"tick"))
+            for i in range(N_FILTERS)]))
+        await asyncio.sleep(0.05)
+    pool = node.deliver_lanes
+    if pool is not None:
+        await pool.drain()
+    return counts
+
+
+def run_case(point: str, kind: str, *, lanes: int = 2,
+             hang_s: float = 0.5, count: int = 1) -> dict:
+    """One faulted run: returns settled counts, per-session order and
+    the supervision counters for the oracle."""
+    node = build_node(lanes=lanes, threshold=1)
+    sup = node.supervisor
+    # fast breaker cycle + tight watchdog so hang faults resolve in
+    # test time (hang_s > watchdog floor ⇒ the stall detector trips)
+    for br in sup.breakers.values():
+        br.base_cooldown_s = br.cooldown_s = 0.05
+    sup.wd_floor_s = 0.1
+    sup.wd_mult = 0.0       # deterministic: deadline == floor
+    delta = point == "overlay_apply"
+    sinks = build_world(node, with_delta=delta)
+    delta_sid = sinks.pop("delta_sid", None)
+    wins = schedule()
+
+    async def go():
+        if point != "snapshot_swap":
+            # snapshot_swap must fault the FIRST build; everything else
+            # warms first so the fault hits a serving device path
+            await _warm(node)
+        spec = f"{point}:{kind}:count={count}"
+        if kind == "hang":
+            spec += f":hang_s={hang_s}"
+        sup.injector = FaultInjector(parse_faults(spec))
+        return await _drive(
+            node, wins,
+            delta_sub=(delta_sid, "d/+") if delta_sid is not None
+            else None)
+
+    counts = asyncio.new_event_loop().run_until_complete(go())
+    m = node.metrics
+    return {
+        "counts": [list(c) for c in counts],
+        "order": {sid: list(s.got) for sid, s in sinks.items()},
+        "faults": m.val(f"supervise.faults.{point}"),
+        "trips": m.val("supervise.trips"),
+        "replays": m.val("supervise.replays"),
+        "stalls": m.val("supervise.stalls"),
+        "probes": m.val("supervise.probes"),
+        "rung_changes": m.val("supervise.rung_changes"),
+        "breakers": {s: b.state for s, b in sup.breakers.items()},
+        "journal_depth": sup.journal_depth(),
+        "fired": sum(f.fired for f in sup.injector.faults),
+        "dropped": m.val("messages.dropped"),
+    }
+
+
+def run_twin(*, lanes: int = 2, delta: bool = False) -> dict:
+    """The fault-free twin: same node shape, same schedule, no armed
+    clauses — the oracle both the counts and the order compare to."""
+    node = build_node(lanes=lanes, threshold=1)
+    sinks = build_world(node, with_delta=delta)
+    delta_sid = sinks.pop("delta_sid", None)
+    wins = schedule()
+
+    async def go():
+        await _warm(node)
+        return await _drive(
+            node, wins, settle_s=0.0,
+            delta_sub=(delta_sid, "d/+") if delta_sid is not None
+            else None)
+
+    counts = asyncio.new_event_loop().run_until_complete(go())
+    return {
+        "counts": [list(c) for c in counts],
+        "order": {sid: list(s.got) for sid, s in sinks.items()},
+    }
+
+
+# stages whose consumer-side await is watchdog-bounded: a hang there
+# MUST trip the breaker (stall detection); at every other point a
+# bounded hang completes inline — slow, but nothing failed and nothing
+# was lost, so the correct outcome is NO trip
+WATCHDOGGED = ("dispatch", "materialize", "mesh_exchange")
+
+
+def grade(case: dict, twin: dict, point: str = "dispatch",
+          kind: str = "exception") -> list:
+    """The chaos oracle. Returns a list of violation strings (empty =
+    green). Counts compare only over the twin's windows (the faulted
+    run's extra settle ticks are all-delivered by the journal contract:
+    every settled count must equal the subscriber fan-out, 2)."""
+    bad = []
+    expect_trip = kind != "hang" or point in WATCHDOGGED
+    # zero lost QoS≥1 deliveries on the scheduled windows
+    for w, twin_counts in enumerate(twin["counts"][:WINDOWS]):
+        if case["counts"][w] != twin_counts:
+            bad.append(f"window {w}: counts diverged "
+                       f"{case['counts'][w][:8]}... != "
+                       f"{twin_counts[:8]}...")
+    for w, counts in enumerate(case["counts"]):
+        if any(c == 0 for c in counts):
+            bad.append(f"window {w}: lost deliveries (count=0)")
+    # per-session order: the twin's sequence must be a PREFIX of the
+    # faulted run's (the settle ticks append extra deliveries)
+    for sid, seq in twin["order"].items():
+        got = case["order"].get(sid, [])
+        if got[:len(seq)] != seq:
+            bad.append(f"sid {sid}: order diverged")
+    if case["fired"] == 0:
+        bad.append("no armed fault ever fired (harness bug)")
+    if expect_trip and case["trips"] < 1:
+        bad.append("breaker never opened")
+    if kind == "hang" and point in WATCHDOGGED and case["stalls"] < 1:
+        bad.append("hang at a watchdogged stage never counted a stall")
+    if any(s != "closed" for s in case["breakers"].values()):
+        bad.append(f"breaker(s) stuck open: {case['breakers']}")
+    if case["journal_depth"] != 0:
+        bad.append(f"window journal leaked {case['journal_depth']}")
+    if case["dropped"] != 0:
+        bad.append(f"{case['dropped']} messages dropped")
+    return bad
+
+
+# the full single-node matrix; mesh_exchange needs a multichip node and
+# rides its own test (tests/test_supervise.py::TestMeshChaos)
+MATRIX_POINTS = tuple(p for p in FAULT_POINTS if p != "mesh_exchange")
+
+
+def main() -> int:
+    t0 = time.time()
+    twin = run_twin()
+    twin_delta = run_twin(delta=True)
+    rows = {}
+    failures = 0
+    for point in MATRIX_POINTS:
+        for kind in FAULT_KINDS:
+            case = run_case(point, kind)
+            bad = grade(case,
+                        twin_delta if point == "overlay_apply" else twin,
+                        point, kind)
+            rows[f"{point}:{kind}"] = {
+                "ok": not bad, "violations": bad,
+                "faults": case["faults"], "trips": case["trips"],
+                "replays": case["replays"], "stalls": case["stalls"],
+            }
+            failures += bool(bad)
+            print(f"{point}:{kind}: "
+                  f"{'ok' if not bad else bad}", file=sys.stderr)
+    out = {
+        "metric": "chaos_matrix",
+        "value": len(rows) - failures,
+        "total": len(rows),
+        "unit": "green-cells",
+        "seconds": round(time.time() - t0, 1),
+        "cells": rows,
+    }
+    print(json.dumps(out), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
