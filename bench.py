@@ -65,6 +65,33 @@ _LAST_TELEMETRY = None
 # window that dies mid-plan still records a measured number
 _PHASE0 = None
 
+# per-phase wall-clock accounting (ISSUE 7 satellite): rounds 3-5 died
+# with `value=0` and NO record of where their minutes went. Every phase
+# stamps its wall seconds here — success OR failure — and the dict
+# rides the checkpoint, the merged JSON and the error JSON, so a dead
+# window's post-mortem starts from "config5 ate 9 of the 12 minutes"
+# instead of a blank
+_PHASE_WALL: dict = {}
+# seconds burned waiting for a relay window (preflight + poll loop) —
+# the other place dead rounds' minutes vanished
+_RELAY_WAIT_S = 0.0
+
+
+class _phase_clock:
+    """Context manager stamping one phase's wall seconds into
+    _PHASE_WALL whether the phase returns or raises."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        _PHASE_WALL[self.name] = round(time.time() - self.t0, 1)
+        return False
+
 
 def _last_measured():
     """Latest committed mid-round hardware measurement (written by
@@ -101,6 +128,12 @@ def _error_json(error) -> str:
         "vs_baseline": 0.0,
         "error": error,
     }
+    # where the dead round's minutes went (ISSUE 7 satellite): phase
+    # wall seconds + relay-wait seconds always ride the error JSON
+    if _PHASE_WALL:
+        doc["phase_wall_s"] = dict(_PHASE_WALL)
+    if _RELAY_WAIT_S:
+        doc["relay_wait_s"] = round(_RELAY_WAIT_S, 1)
     lm = _last_measured()
     if lm:
         doc["last_measured"] = lm
@@ -154,6 +187,14 @@ def _ckpt_load(sig: dict) -> dict:
         return {}
     phases = doc.get("phases") or {}
     if phases:
+        # resumed phases keep their measured wall seconds — the merged
+        # JSON's accounting spans the dying run AND its resume
+        _PHASE_WALL.update(doc.get("walls") or {})
+        # likewise the dying run's relay wait (the BENCH_r05 540s):
+        # _ckpt_load runs after THIS run's backend probe has already
+        # set _RELAY_WAIT_S, so the two accumulate
+        global _RELAY_WAIT_S
+        _RELAY_WAIT_S += float(doc.get("relay_wait_s") or 0.0)
         log(f"bench resume: phases {sorted(phases)} from "
             f"{_ckpt_path()}")
     return phases
@@ -170,7 +211,8 @@ def _ckpt_put(name: str, value, sig: dict, phases: dict) -> None:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"sig": sig, "ts": time.time(),
-                       "phases": phases}, f)
+                       "phases": phases, "walls": _PHASE_WALL,
+                       "relay_wait_s": round(_RELAY_WAIT_S, 1)}, f)
         os.replace(tmp, path)
     except Exception as e:  # noqa: BLE001 — checkpointing is insurance,
         log(f"bench checkpoint write failed ({e})")  # not a dependency
@@ -1318,7 +1360,23 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
         # occupancy per shape class, compile accounting — one schema
         # shared with GET /api/v5/pipeline/stats and profile_step.py
         try:
-            out_extra["telemetry"] = node.pipeline_telemetry.snapshot()
+            snap = node.pipeline_telemetry.snapshot()
+            out_extra["telemetry"] = snap
+            # flight-recorder overlap summary (ISSUE 7), surfaced at
+            # the top of the phase row so the next TPU relay window's
+            # post-mortem reads the dispatch↔materialize overlap and
+            # the top bubble attributions without digging — the e2e
+            # gap diagnosis even if the round dies right after
+            tr = snap.get("trace") or {}
+            if tr.get("overlap") or tr.get("bubbles"):
+                out_extra["overlap"] = {
+                    "dispatch_materialize":
+                        (tr.get("overlap") or {}).get(
+                            "dispatch_materialize"),
+                    "windows": tr.get("windows"),
+                    "bubbles_top":
+                        (tr.get("bubbles") or {}).get("top"),
+                }
         except Exception as e:  # noqa: BLE001 — diagnosis must not kill data
             log(f"telemetry snapshot failed: {type(e).__name__}: {e}")
         return {
@@ -1490,6 +1548,8 @@ def main():
             os._exit(2)
 
     ok, detail = False, "relay never came up"
+    global _RELAY_WAIT_S
+    t_relay = time.time()
     while time.time() < deadline:
         if axon and not relay_listening():
             log("relay not listening; waiting for a window "
@@ -1515,10 +1575,15 @@ def main():
         log(f"backend probe failed ({detail}); "
             f"retrying while budget lasts")
         time.sleep(10)
+    # relay/backend-init wait accounting (ISSUE 7 satellite): the other
+    # place a dead round's minutes vanished — BENCH_r05 burned 540s
+    # here and the JSON never said so
+    _RELAY_WAIT_S = time.time() - t_relay
     if not ok:
         print(_error_json(f"backend init failed: {detail}"), flush=True)
         os._exit(2)
-    log(f"backend probe ok: {detail} device(s)")
+    log(f"backend probe ok: {detail} device(s) "
+        f"(waited {_RELAY_WAIT_S:.0f}s)")
 
     requested = int(os.environ.get("BENCH_SUBS", 10_000_000))
     B = int(os.environ.get("BENCH_BATCH", 131072))
@@ -1559,8 +1624,9 @@ def main():
             try:
                 signal.alarm(int(os.environ.get("BENCH_PHASE0_TIMEOUT_S",
                                                 240)))
-                _PHASE0 = run_phase0(
-                    int(os.environ.get("BENCH_SHARED_PCT", 50)))
+                with _phase_clock("phase0"):
+                    _PHASE0 = run_phase0(
+                        int(os.environ.get("BENCH_SHARED_PCT", 50)))
                 print(json.dumps(_PHASE0), flush=True)
                 _ckpt_put("phase0", _PHASE0, sig, phases)
             except Exception as e:  # noqa: BLE001 — best-effort pre-phase
@@ -1582,7 +1648,8 @@ def main():
                 result = dict(phases[core_key])
                 log(f"{core_key}: resumed from checkpoint")
             else:
-                result = run_bench(subs, B, window, shared_pct)
+                with _phase_clock(core_key):
+                    result = run_bench(subs, B, window, shared_pct)
                 # committed pristine, before the sections below attach
                 _ckpt_put(core_key, dict(result), sig, phases)
             if _PHASE0:
@@ -1604,8 +1671,9 @@ def main():
                 try:
                     signal.alarm(int(os.environ.get(
                         "BENCH_CONFIGS_TIMEOUT_S", 600)))
-                    result["configs"] = run_baseline_configs(
-                        min(B, 32768), max(8, window // 4))
+                    with _phase_clock("configs"):
+                        result["configs"] = run_baseline_configs(
+                            min(B, 32768), max(8, window // 4))
                     _ckpt_put("configs", result["configs"], sig, phases)
                 except Exception as e:  # noqa: BLE001 — best-effort
                     signal.alarm(0)   # before anything else: the pending
@@ -1632,9 +1700,11 @@ def main():
                     signal.alarm(int(os.environ.get(
                         "BENCH_C5_TIMEOUT_S",
                         max(600, 300 + c5_routes // 5_000))))
-                    result["config5"] = run_config5(
-                        c5_routes,
-                        int(os.environ.get("BENCH_C5_RETAINED", 100_000)))
+                    with _phase_clock("config5"):
+                        result["config5"] = run_config5(
+                            c5_routes,
+                            int(os.environ.get("BENCH_C5_RETAINED",
+                                               100_000)))
                     _ckpt_put("config5", result["config5"], sig, phases)
                 except Exception as e:  # noqa: BLE001 — best-effort
                     signal.alarm(0)
@@ -1664,8 +1734,9 @@ def main():
                         continue
                     try:
                         signal.alarm(budget * share // 3)
-                        result[name] = run_e2e(ef, 16, 8, em // 8,
-                                               use_device)
+                        with _phase_clock(name):
+                            result[name] = run_e2e(ef, 16, 8, em // 8,
+                                                   use_device)
                         _ckpt_put(name, result[name], sig, phases)
                     except Exception as e:  # noqa: BLE001 — best-effort
                         signal.alarm(0)
@@ -1693,14 +1764,15 @@ def main():
                     senv = dict(os.environ)
                     senv.pop("PALLAS_AXON_POOL_IPS", None)
                     senv["JAX_PLATFORMS"] = "cpu"
-                    sp = subprocess.run(
-                        [sys.executable,
-                         os.path.join(os.path.dirname(
-                             os.path.abspath(__file__)),
-                             "tools", "sharded_bench.py")],
-                        capture_output=True, text=True, env=senv,
-                        timeout=int(os.environ.get(
-                            "BENCH_SHARDED_TIMEOUT_S", 1200)))
+                    with _phase_clock("sharded"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "sharded_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_SHARDED_TIMEOUT_S", 1200)))
                     row = None
                     for ln in reversed(sp.stdout.splitlines()):
                         if ln.strip().startswith("{"):
@@ -1728,14 +1800,15 @@ def main():
                     senv = dict(os.environ)
                     senv.pop("PALLAS_AXON_POOL_IPS", None)
                     senv["JAX_PLATFORMS"] = "cpu"
-                    sp = subprocess.run(
-                        [sys.executable,
-                         os.path.join(os.path.dirname(
-                             os.path.abspath(__file__)),
-                             "tools", "skew_bench.py")],
-                        capture_output=True, text=True, env=senv,
-                        timeout=int(os.environ.get(
-                            "BENCH_SKEW_TIMEOUT_S", 600)))
+                    with _phase_clock("skew"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "skew_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_SKEW_TIMEOUT_S", 600)))
                     row = None
                     for ln in reversed(sp.stdout.splitlines()):
                         if ln.strip().startswith("{"):
@@ -1768,14 +1841,15 @@ def main():
                     senv = dict(os.environ)
                     senv.pop("PALLAS_AXON_POOL_IPS", None)
                     senv["JAX_PLATFORMS"] = "cpu"
-                    sp = subprocess.run(
-                        [sys.executable,
-                         os.path.join(os.path.dirname(
-                             os.path.abspath(__file__)),
-                             "tools", "churn_bench.py")],
-                        capture_output=True, text=True, env=senv,
-                        timeout=int(os.environ.get(
-                            "BENCH_CHURN_TIMEOUT_S", 600)))
+                    with _phase_clock("churn"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "churn_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_CHURN_TIMEOUT_S", 600)))
                     row = None
                     for ln in reversed(sp.stdout.splitlines()):
                         if ln.strip().startswith("{"):
@@ -1805,14 +1879,15 @@ def main():
                     senv = dict(os.environ)
                     senv.pop("PALLAS_AXON_POOL_IPS", None)
                     senv["JAX_PLATFORMS"] = "cpu"
-                    sp = subprocess.run(
-                        [sys.executable,
-                         os.path.join(os.path.dirname(
-                             os.path.abspath(__file__)),
-                             "tools", "fanout_bench.py")],
-                        capture_output=True, text=True, env=senv,
-                        timeout=int(os.environ.get(
-                            "BENCH_FANOUT_TIMEOUT_S", 600)))
+                    with _phase_clock("fanout"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "fanout_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_FANOUT_TIMEOUT_S", 600)))
                     row = None
                     for ln in reversed(sp.stdout.splitlines()):
                         if ln.strip().startswith("{"):
@@ -1831,6 +1906,13 @@ def main():
                     log(f"fanout bench failed: {type(e).__name__}: {e}")
                     result["fanout_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
+            # where the round's minutes went (ISSUE 7 satellite):
+            # per-phase wall seconds + relay/backend-init wait, in the
+            # merged JSON whether the phases succeeded or not
+            if _PHASE_WALL:
+                result["phase_wall_s"] = dict(_PHASE_WALL)
+            if _RELAY_WAIT_S:
+                result["relay_wait_s"] = round(_RELAY_WAIT_S, 1)
             print(json.dumps(result), flush=True)
             # the merged JSON is committed: the checkpoint has served
             # its purpose (a stale one would pollute the next round)

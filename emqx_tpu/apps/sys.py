@@ -75,7 +75,9 @@ class SysBroker:
         (dense-vs-compact device→host transfer bytes, ISSUE 3) /
         `pipeline/rebuild` / `pipeline/deliver` (delivery-lane egress
         stage, ISSUE 5) / `pipeline/supervise` (fault-domain
-        supervision: breaker states, ladder rung, ISSUE 6)."""
+        supervision: breaker states, ladder rung, ISSUE 6) /
+        `pipeline/trace` (window-causal flight recorder: ring state +
+        dispatch↔materialize overlap + bubble attribution, ISSUE 7)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -91,7 +93,7 @@ class SysBroker:
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
-                        "deliver", "supervise"):
+                        "deliver", "supervise", "trace"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

@@ -60,6 +60,11 @@ class Tracer:
     def __init__(self, node):
         self.node = node
         self._traces: dict[tuple[str, str], Trace] = {}
+        # (monotonic ts, overlap, top bubbles) — the slow-batch causal
+        # context is re-analyzed at most once per _SLOW_CTX_TTL_S
+        self._slow_ctx: Optional[tuple] = None
+
+    _SLOW_CTX_TTL_S = 5.0
 
     def load(self) -> "Tracer":
         h = self.node.hooks
@@ -126,9 +131,37 @@ class Tracer:
     def on_batch_slow(self, info: dict) -> None:
         """`batch.slow` hook (broker.telemetry.record_total): a publish
         batch exceeded the slow-batch threshold — always logged, and
-        mirrored into active slow_batch trace files."""
+        mirrored into active slow_batch trace files. With the ISSUE-7
+        flight recorder on, the line carries the causal context the
+        triage order reads first: the dispatch↔materialize overlap and
+        the top bubble attribution of the recent windows (so a slow
+        batch names WHERE its time went before anyone opens a metric
+        dashboard)."""
         line = ("SLOW_BATCH " +
                 " ".join(f"{k}={info[k]}" for k in sorted(info)))
+        rec = getattr(self.node, "flight_recorder", None)
+        if rec is not None:
+            try:
+                # a degraded pipeline makes EVERY batch slow — the
+                # full-ring analysis runs on the event loop, so reuse
+                # the last one for _SLOW_CTX_TTL_S instead of paying
+                # O(ring) per batch exactly when the broker is slow
+                now = time.monotonic()
+                ctx = self._slow_ctx
+                if ctx is None or now - ctx[0] > self._SLOW_CTX_TTL_S:
+                    a = rec.analyze(per_window=1)
+                    ctx = self._slow_ctx = (
+                        now,
+                        (a.get("overlap") or {}).get(
+                            "dispatch_materialize"),
+                        (a.get("bubbles") or {}).get("top") or [])
+                _ts, ov, top = ctx
+                if ov is not None:
+                    line += f" overlap={ov}"
+                if top:
+                    line += " top_bubble=%s:%.3fs" % tuple(top[0])
+            except Exception:  # noqa: BLE001 — context is best-effort
+                pass
         log.warning("%s", line)
         for t in self._traces.values():
             if t.kind == "slow_batch":

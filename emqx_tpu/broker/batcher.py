@@ -66,6 +66,11 @@ class PublishBatcher:
         # gate all hang off this. None (knob off / bare test nodes)
         # restores the pre-ISSUE-6 unwind behavior exactly.
         self.sup = getattr(node, "supervisor", None)
+        # window-causal flight recorder (ISSUE 7): every window's trace
+        # id is minted HERE at admit and rides the entry dict through
+        # dispatch/materialize/replay/lanes to settle. None (knob off /
+        # bare test nodes) restores the pre-ISSUE-7 behavior exactly.
+        self.rec = getattr(node, "flight_recorder", None)
         self.window_s = window_us / 1e6
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
@@ -196,19 +201,39 @@ class PublishBatcher:
                     limit = min(self.max_batch, cap) if cap else \
                         self.max_batch
                     batch = []
+                    rec = self.rec
+                    sampled = None
                     t_enq = self._q_times[0] if self._q_times else \
                         time.perf_counter()
                     while self._queue and len(batch) < limit:
                         batch.append(self._queue.popleft())
-                        self._q_times.popleft()
+                        tq = self._q_times.popleft()
+                        if rec is not None and rec.sample_hit():
+                            # sampled per-message span (ISSUE 7): this
+                            # message records its own enqueue→settle
+                            # interval on the window trace
+                            if sampled is None:
+                                sampled = []
+                            sampled.append((len(batch) - 1, tq))
+                    now = time.perf_counter()
                     if self.tele is not None:
                         # enqueue stage: oldest-message queue wait before
                         # its batch formed (upper-bounds the batch)
-                        self.tele.observe_stage(
-                            "enqueue", time.perf_counter() - t_enq)
+                        self.tele.observe_stage("enqueue", now - t_enq)
                     entry = {"batch": batch, "handle": None, "sub": 0,
                              "dispatch_fut": None, "live": None,
                              "live_idx": None, "t_enq": t_enq}
+                    if rec is not None:
+                        # the window's trace id, minted at admit; the
+                        # enqueue span doubles as the root every later
+                        # span parents to
+                        tid = rec.new_trace()
+                        entry["trace"] = tid
+                        entry["root_span"] = rec.record(
+                            tid, "enqueue", t_enq, now, track="batcher",
+                            meta={"batch": len(batch)})
+                        if sampled:
+                            entry["trace_msgs"] = sampled
                     if self.sup is not None:
                         # window journal (ISSUE 6): the window is
                         # journaled the moment it is admitted to the
@@ -310,6 +335,26 @@ class PublishBatcher:
                             # interval 8x
                             self._since_host_probe += len(lives)
                             self._since_probe = 0   # device just tried
+                            if self.rec is not None:
+                                # causal propagation (ISSUE 7): the
+                                # fused dispatch records under the LEAD
+                                # entry's trace; per-sub traces ride
+                                # sub_traces so deliver/lane spans land
+                                # on their own window, and fused
+                                # followers link to the lead
+                                handle.trace = \
+                                    first_live.get("trace", 0)
+                                handle.sub_traces = [
+                                    e.get("trace", 0) for e in group
+                                    if e["live"]]
+                                for e in group:
+                                    if e["live"] and e is not first_live \
+                                            and "trace" in e:
+                                        self.rec.event(
+                                            e["trace"], "fused",
+                                            track="batcher",
+                                            parent=e.get("root_span", 0),
+                                            meta={"lead": handle.trace})
                             first_live["dispatch_fut"] = \
                                 loop.run_in_executor(
                                     self._dispatch_pool,
@@ -436,6 +481,10 @@ class PublishBatcher:
         if self.tele is not None:
             self.tele.observe_stage("batch_form",
                                     time.perf_counter() - t0)
+        if self.rec is not None and "trace" in entry:
+            self.rec.record(entry["trace"], "batch_form", t0,
+                            time.perf_counter(), track="batcher",
+                            parent=entry.get("root_span", 0))
 
     # ---- consumer: complete batches strictly in order --------------------
     async def _complete_host(self, entry: dict, routed=None) -> None:
@@ -449,6 +498,8 @@ class PublishBatcher:
         batch = entry["batch"]
         counts = [0] * len(batch)
         tele = self.tele
+        rec = self.rec
+        tid = entry.get("trace") if rec is not None else None
         path = "host" if routed is None else "device"
         try:
             if "error" in entry:
@@ -463,7 +514,14 @@ class PublishBatcher:
                 # consumer exists to preserve
                 pool = getattr(self.node, "deliver_lanes", None)
                 if pool is not None and pool.busy():
+                    t_d = time.perf_counter()
                     await pool.drain()
+                    if tid is not None:
+                        # a real wait on the lanes: the
+                        # lane-backpressure bubble, named
+                        rec.record(tid, "lane_drain", t_d,
+                                   time.perf_counter(), track="batcher",
+                                   parent=entry.get("root_span", 0))
                 t0 = time.perf_counter()
                 routed = []
                 broker = self.node.broker
@@ -484,6 +542,15 @@ class PublishBatcher:
                 span = time.perf_counter() - t0
                 if tele is not None:
                     tele.observe_stage("host_route", span)
+                if tid is not None:
+                    # a replayed window's host re-route is a CHILD of
+                    # its replay span — the original trace id is kept
+                    # (ISSUE 7 satellite: causality survives the
+                    # degradation ladder)
+                    rec.record(tid, "host_route", t0,
+                               time.perf_counter(), track="host",
+                               parent=entry.get("replay_span")
+                               or entry.get("root_span", 0))
                 self._host_msg_s, self._host_spike = _ewma(
                     self._host_msg_s, span / len(live),
                     self._host_spike)
@@ -511,6 +578,21 @@ class PublishBatcher:
                     if tele is not None:
                         tele.record_total(total, batch=len(batch),
                                           path=path)
+                if tid is not None:
+                    now = time.perf_counter()
+                    w0 = entry.get("t_enq") or now
+                    # the window roll-up span (admit → settle) + the
+                    # sampled per-message enqueue→settle spans
+                    rec.record(tid, "window", w0, now, track="window",
+                               meta={"path": path,
+                                     "batch": len(batch)})
+                    for i, tq in entry.get("trace_msgs", ()):
+                        m = batch[i][0]
+                        rec.record(tid, "message", tq, now,
+                                   track="messages",
+                                   parent=entry.get("root_span", 0),
+                                   meta={"topic": m.topic,
+                                         "qos": m.qos})
 
             # deliver-lane hand-off (ISSUE 5): a LaneCounts carries the
             # in-flight DeliveryPlan — publisher futures resolve when
@@ -582,19 +664,22 @@ class PublishBatcher:
                     await entry["dispatch_fut"]
                     await loop.run_in_executor(
                         self._read_pool, self.engine.materialize, handle)
-                except Exception:
+                except Exception as e:
                     self.engine.abandon(handle)
                     self.node.metrics.inc(
                         "routing.device.dispatch_failed")
+                    self._note_replay_span(entry, "device",
+                                           type(e).__name__)
                     return None
             else:
                 if not await self._await_stage(
-                        entry["dispatch_fut"], "dispatch", handle):
+                        entry["dispatch_fut"], "dispatch", handle,
+                        entry):
                     return None
                 mat = loop.run_in_executor(
                     self._read_pool, self.engine.materialize, handle)
                 if not await self._await_stage(mat, "materialize",
-                                               handle):
+                                               handle, entry):
                     return None
         if handle.built is None or handle.np_res is None:
             # the window's dispatching entry failed/abandoned earlier
@@ -615,6 +700,8 @@ class PublishBatcher:
                 sup.note_fault("materialize", e)
                 sup.note_replay()
                 self.node.metrics.inc("routing.device.dispatch_failed")
+                self._note_replay_span(entry, "consume",
+                                       type(e).__name__)
                 return None
         pool = getattr(self.node, "deliver_lanes", None)
         if pool is not None and pool.active():
@@ -623,7 +710,15 @@ class PublishBatcher:
             # the producer's put, which bounces submit()/enqueue() —
             # a blocked lane therefore stalls publishers instead of
             # buffering (or dropping) deliveries unboundedly
+            t_a = time.perf_counter()
             await pool.admit()
+            if self.rec is not None and "trace" in entry \
+                    and time.perf_counter() - t_a > 5e-4:
+                # only a REAL wait is a lane-backpressure bubble worth
+                # a span; the no-wait fast path stays unrecorded
+                self.rec.record(entry["trace"], "lane_admit", t_a,
+                                time.perf_counter(), track="batcher",
+                                parent=entry.get("root_span", 0))
         done = time.perf_counter()
         if sub == n_subs - 1:
             if sup is not None:
@@ -649,7 +744,8 @@ class PublishBatcher:
             self._fuse_cwnd = min(8, max(2, 2 * n_subs))
         return counts
 
-    async def _await_stage(self, fut, stage: str, handle) -> bool:
+    async def _await_stage(self, fut, stage: str, handle,
+                           entry: Optional[dict] = None) -> bool:
         """Await one off-loop stage under the supervisor's watchdog
         deadline. Returns False (handle abandoned, fault noted, replay
         counted — caller falls back to the host rung) on timeout or
@@ -669,14 +765,31 @@ class PublishBatcher:
             self.node.metrics.inc("routing.device.dispatch_failed")
             sup.note_stall(stage)
             sup.note_replay()
+            self._note_replay_span(entry, stage, "stall")
             return False
         except Exception as e:
             self.engine.abandon(handle)
             self.node.metrics.inc("routing.device.dispatch_failed")
             sup.note_fault(stage, e)
             sup.note_replay()
+            self._note_replay_span(entry, stage, type(e).__name__)
             return False
         return True
+
+    def _note_replay_span(self, entry: Optional[dict], stage: str,
+                          kind: str) -> None:
+        """ISSUE 7 satellite: a window re-routed through the host rung
+        KEEPS its original trace id; the replay itself is linked as a
+        child span of the window root, and the host_route that follows
+        parents to the replay — the causal chain survives the
+        supervise replay."""
+        rec = self.rec
+        if rec is None or entry is None or "trace" not in entry:
+            return
+        entry["replay_span"] = rec.event(
+            entry["trace"], "replay", track="batcher",
+            parent=entry.get("root_span", 0),
+            meta={"stage": stage, "kind": kind})
 
     def lat_percentiles(self) -> Optional[dict]:
         """PUBLISH→route latency percentiles (ms) over the reservoir."""

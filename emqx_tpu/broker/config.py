@@ -72,6 +72,17 @@ DEFAULTS: dict = {
         # consecutive faults before a stage's circuit breaker opens
         # (None = EMQX_TPU_BREAKER_THRESHOLD, then 3)
         "supervise_threshold": None,
+        # None = resolve via EMQX_TPU_TRACE, then default-on
+        # (broker/trace.resolve_trace); false restores the pre-ISSUE-7
+        # behavior exactly (no flight recorder, no spans anywhere) —
+        # the tracing A/B baseline. A baked-in bool here would shadow
+        # the env knob through the defaults merge.
+        "trace": None,
+        # per-message span sampling 1-in-N (None = EMQX_TPU_TRACE_SAMPLE,
+        # then 256; 0 disables message spans, window spans stay on)
+        "trace_sample": None,
+        # flight-recorder ring capacity, in spans
+        "trace_ring": 4096,
         "perf": {"trie_compaction": True},
     },
     "zones": {},                 # zone name -> {mqtt: {...}} overrides

@@ -352,7 +352,7 @@ class DeliveryPlan:
     __slots__ = ("pool", "msgs", "counts", "fast_idx", "slow_items",
                  "filters", "_chunks", "routed_device", "pending",
                  "done", "target", "_cbs", "s_midx", "s_sid", "s_opt",
-                 "s_fid", "_barrier_left", "_barrier_evt")
+                 "s_fid", "_barrier_left", "_barrier_evt", "trace")
 
     def __init__(self, pool: "DeliveryLanePool", msgs: list):
         self.pool = pool
@@ -370,6 +370,11 @@ class DeliveryPlan:
         self.s_midx = self.s_sid = self.s_opt = self.s_fid = None
         self._barrier_left = 0
         self._barrier_evt: Optional[asyncio.Event] = None
+        # flight-recorder trace id (ISSUE 7): set by the engine from
+        # its window handle; lane work records against it, and it
+        # SURVIVES a lane-worker restart because the queue items carry
+        # the plan (the causal context is data, not task state)
+        self.trace = 0
 
     # -- building (engine consume stage, event loop) --
     def register_fast(self, indices) -> None:
@@ -854,8 +859,17 @@ class DeliveryLanePool:
                 # lane_depth overreports a stuck-deep lane forever
                 self._lane_items[lane] -= 1
             if tele is not None and worked:
-                tele.observe_stage(f"deliver_lane{lane}",
-                                   time.perf_counter() - t0)
+                now = time.perf_counter()
+                tele.observe_stage(f"deliver_lane{lane}", now - t0)
+                rec = getattr(tele, "recorder", None)
+                if rec is not None:
+                    # item is ("slice", plan, lo, hi) or ("barrier",
+                    # plan): either way the plan rides at [1] and
+                    # carries its window's trace
+                    tr = getattr(item[1], "trace", 0)
+                    if tr:
+                        rec.record(tr, f"lane{lane}", t0, now,
+                                   track=f"lane{lane}")
 
     def _surrender(self, item) -> None:
         """Account a popped-but-unprocessed queue item when its worker

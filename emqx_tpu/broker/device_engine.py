@@ -454,7 +454,8 @@ class _Handle:
 
     __slots__ = ("subs", "built", "dev_shared", "enc", "res", "np_res",
                  "np_counts", "error", "refs", "t0", "plan", "cache_info",
-                 "pcap", "cres", "delta", "dres", "dcres", "np_delta")
+                 "pcap", "cres", "delta", "dres", "dcres", "np_delta",
+                 "trace", "sub_traces")
 
     def __init__(self, subs, built, dev_shared):
         self.subs = subs          # list of (msgs, words_list, too_long)
@@ -474,6 +475,11 @@ class _Handle:
         self.dres = None      # device DeltaPlanes (set by dispatch)
         self.dcres = None     # device delta CompactPlanes
         self.np_delta = None  # host views: _DeltaRes or _DeltaCsr
+        self.trace = 0        # flight-recorder trace id (ISSUE 7):
+        #                       the LEAD entry's window trace — rides
+        #                       the StepTraceAnnotation so the device
+        #                       timeline joins the host one
+        self.sub_traces = None  # per-sub-batch trace ids (fused windows)
 
 
 class DeviceRouteEngine:
@@ -2258,13 +2264,23 @@ class DeviceRouteEngine:
         finally:
             if tele is not None:
                 tele.observe_stage(stage, time.perf_counter() - t0)
+            self._rec_span(h.trace, stage, t0, track="dispatch",
+                           meta={"W": h.enc[0].shape[0],
+                                 "B": h.enc[0].shape[1]})
 
     def _dispatch_annotated(self, h) -> None:
         if getattr(self, "_tracing", False):
             import jax
-            self._step_num = getattr(self, "_step_num", 0) + 1
+            # the step_num IS the window's flight-recorder trace id
+            # (ISSUE 7): a jax.profiler capture's device timeline joins
+            # the host-side Perfetto dump on the same key. Windows with
+            # no trace (knob off) keep the old private counter.
+            step = h.trace
+            if not step:
+                self._step_num = getattr(self, "_step_num", 0) + 1
+                step = self._step_num
             with jax.profiler.StepTraceAnnotation("route_step",
-                                                  step_num=self._step_num):
+                                                  step_num=step):
                 self._dispatch_inner(h)
         else:
             self._dispatch_inner(h)
@@ -2574,6 +2590,8 @@ class DeviceRouteEngine:
                 if tele is not None:
                     tele.observe_stage("materialize",
                                        time.perf_counter() - t0)
+                self._rec_span(h.trace, "materialize", t0,
+                               track="materialize")
                 return
         h.np_res = (np.asarray(res.matches), np.asarray(res.rows),
                     np.asarray(res.opts), np.asarray(res.shared_sids),
@@ -2609,6 +2627,16 @@ class DeviceRouteEngine:
             self._corrupt_readback(h)
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
+        self._rec_span(h.trace, "materialize", t0, track="materialize")
+
+    def _rec_span(self, trace_id: int, name: str, t0: float, *,
+                  track: str, parent: int = 0, meta=None) -> None:
+        """Record one [t0, now] span on the flight recorder (no-op
+        when tracing is off or the window carries no trace)."""
+        rec = getattr(self.node, "flight_recorder", None)
+        if rec is not None and trace_id:
+            rec.record(trace_id, name, t0, time.perf_counter(),
+                       track=track, parent=parent, meta=meta)
 
     def _corrupt_readback(self, h) -> None:
         """Apply the injected corrupt-shape fault: truncate the window
@@ -2704,6 +2732,14 @@ class DeviceRouteEngine:
                     plan = pool.new_plan(msgs)  # None without a loop
                     if plan is not None:
                         plan.routed_device = True
+                        # causal propagation (ISSUE 7): the plan
+                        # carries its sub-batch's trace, so lane items
+                        # record against the right window — and KEEP it
+                        # across a lane-worker restart (queue items
+                        # hold the plan, the plan holds the trace)
+                        plan.trace = h.sub_traces[k] \
+                            if h.sub_traces and k < len(h.sub_traces) \
+                            else h.trace
             if csr:
                 fast = self._consume_batch_fast_csr(
                     msgs, nr.off[k], nr.c3[k], nr.pay[k], too_long,
@@ -2779,6 +2815,10 @@ class DeviceRouteEngine:
         finally:
             if tele is not None:
                 tele.observe_stage("deliver", time.perf_counter() - t0)
+            self._rec_span(h.sub_traces[k]
+                           if h.sub_traces and k < len(h.sub_traces)
+                           else h.trace,
+                           "deliver", t0, track="consume")
             if not deferred:
                 self._release_one(h)
 

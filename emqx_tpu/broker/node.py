@@ -64,6 +64,22 @@ class Node:
                                          False))
         self.device_engine = None
         self.publish_batcher = None
+        # window-causal flight recorder (ISSUE 7): trace ids minted at
+        # batcher admit ride the whole pipeline (dispatch/materialize/
+        # replay/lanes/settle) into a bounded span ring — always on at
+        # window granularity, dumpable post-mortem (GET /api/v5/
+        # pipeline/trace?format=perfetto). broker.trace /
+        # EMQX_TPU_TRACE =0 restores the pre-ISSUE-7 behavior exactly
+        # (self.flight_recorder stays None everywhere).
+        self.flight_recorder = None
+        mc = perf.get("multichip") or {}
+        from emqx_tpu.broker.trace import FlightRecorder, resolve_trace
+        if resolve_trace(perf.get("trace")) \
+                and (use_device or mc.get("enable")):
+            self.flight_recorder = FlightRecorder(
+                self.metrics, cap=perf.get("trace_ring", 4096),
+                sample=perf.get("trace_sample"))
+            self.pipeline_telemetry.recorder = self.flight_recorder
         # fault-domain supervision (ISSUE 6): the per-node supervision
         # tree every pipeline stage plugs into — fault injection points,
         # per-stage circuit breakers driving the degradation ladder
@@ -74,7 +90,6 @@ class Node:
         self.supervisor = None
         from emqx_tpu.broker.supervise import (PipelineSupervisor,
                                                resolve_supervise)
-        mc = perf.get("multichip") or {}
         if resolve_supervise(perf.get("supervise")) \
                 and (use_device or mc.get("enable")):
             self.supervisor = PipelineSupervisor(
@@ -82,6 +97,11 @@ class Node:
                 threshold=perf.get("supervise_threshold"))
             self.pipeline_telemetry.supervise_state_fn = \
                 self.supervisor.state
+            # rung changes / trips / restarts land in the flight
+            # recorder as node-scope events (trace id 0) — the causal
+            # timeline shows WHEN the ladder moved relative to the
+            # windows that tripped it
+            self.supervisor.recorder = self.flight_recorder
         # session-affine delivery lanes (ISSUE 5): the overlapped egress
         # stage both engines' consume hands plans to. 0 lanes (config
         # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores

@@ -464,6 +464,11 @@ class PipelineSupervisor:
             else watchdog_cap_s
         self.wd_mult = _WD_MULT if watchdog_mult is None \
             else watchdog_mult
+        # flight recorder (ISSUE 7; set by the node when tracing is
+        # on): trips / rung changes / restarts land as node-scope
+        # events on the causal timeline, so a post-mortem dump shows
+        # WHEN the ladder moved relative to the windows around it
+        self.recorder = None
         self._probe_fns: dict[str, Callable[[], None]] = {}
         self._probe_tasks: dict[str, "asyncio.Task"] = {}
         self._journal: dict[int, _JournalEntry] = {}
@@ -492,8 +497,18 @@ class PipelineSupervisor:
         before = self.rung()
         if br.record_fault():
             m.inc("supervise.trips")
-            if self.rung() != before:
+            rung_moved = self.rung() != before
+            if rung_moved:
                 m.inc("supervise.rung_changes")
+            if self.recorder is not None:
+                # orthogonal-gate breakers (lane_deliver,
+                # snapshot_swap) trip without moving the rung — the
+                # timeline event must agree with the rung_changes
+                # counter, so those record as "trip"
+                self.recorder.event(
+                    0, "rung_change" if rung_moved else "trip",
+                    meta={"point": point, "rung": self.rung(),
+                          "trip": True})
             log.warning(
                 "breaker %s OPEN after %d consecutive fault(s)%s — "
                 "pipeline now at rung %d", point, br.threshold,
@@ -516,6 +531,8 @@ class PipelineSupervisor:
     def note_restart(self, what: str) -> None:
         self.metrics.inc("supervise.restarts")
         self.metrics.inc(f"supervise.restarts.{what}")
+        if self.recorder is not None:
+            self.recorder.event(0, "restart", meta={"what": what})
 
     def note_replay(self) -> None:
         self.metrics.inc("supervise.replays")
@@ -616,6 +633,11 @@ class PipelineSupervisor:
         br.probe_ok()
         if self.rung() != before:
             self.metrics.inc("supervise.rung_changes")
+            if self.recorder is not None:
+                self.recorder.event(
+                    0, "rung_change",
+                    meta={"point": stage, "rung": self.rung(),
+                          "trip": False})
         log.info("probe %s ok: breaker closed — pipeline back at "
                  "rung %d", stage, self.rung())
 

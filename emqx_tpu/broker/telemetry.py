@@ -130,6 +130,11 @@ class PipelineTelemetry:
         # ISSUE-6 PipelineSupervisor exists): breaker states, ladder
         # rung, window-journal depth, armed fault clauses
         self.supervise_state_fn = None
+        # the window-causal flight recorder (ISSUE 7; set by the node
+        # when broker.trace / EMQX_TPU_TRACE is on): snapshot() derives
+        # the `trace` section — ring state + overlap/bubble analysis —
+        # from it. None restores the pre-ISSUE-7 schema exactly.
+        self.recorder = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -244,11 +249,17 @@ class PipelineTelemetry:
                           n_buckets=_STAGE_BUCKETS).observe(dur)
 
     # ---- snapshot (the shared schema) -----------------------------------
-    def snapshot(self) -> dict:
+    def snapshot(self, full: bool = False) -> dict:
         """The one pipeline-telemetry JSON schema: served by
         GET /api/v5/pipeline/stats, embedded in bench.py's success and
         error JSON, dumped by tools/profile_step.py --telemetry-out and
-        published (piecewise) on $SYS/brokers/<node>/pipeline/#."""
+        published (piecewise) on $SYS/brokers/<node>/pipeline/#.
+
+        ``full=True`` emits EVERY section of the schema (rebuild /
+        deliver / supervise / readback / match_cache / dedup / trace),
+        empty when the layer has no traffic — consumers that diff
+        snapshots across rounds (profile_step, offline tooling) get a
+        stable shape instead of sections popping in and out."""
         stages = {}
         occupancy = {}
         prefix_s, prefix_o = "pipeline.stage.", "pipeline.occupancy."
@@ -422,6 +433,16 @@ class PipelineTelemetry:
                 supervise["state"] = self.supervise_state_fn()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # window-causal flight recorder (ISSUE 7): ring state + the
+        # overlap/bubble analysis — the section bench rounds read for
+        # the dispatch↔materialize overlap fraction and the top bubble
+        # attributions per window
+        trace = {}
+        if self.recorder is not None:
+            try:
+                trace = self.recorder.snapshot_section()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -429,18 +450,20 @@ class PipelineTelemetry:
             "compiles": compiles,
             "decisions": decisions,
         }
-        if supervise:
+        if supervise or full:
             out["supervise"] = supervise
-        if rebuild:
+        if rebuild or full:
             out["rebuild"] = rebuild
-        if deliver:
+        if deliver or full:
             out["deliver"] = deliver
-        if cache:
+        if cache or full:
             out["match_cache"] = cache
-        if dedup:
+        if dedup or full:
             out["dedup"] = dedup
-        if readback:
+        if readback or full:
             out["readback"] = readback
+        if trace or full:
+            out["trace"] = trace
         jc = _jit_cache_sizes()
         if jc:
             out["jit_cache"] = jc

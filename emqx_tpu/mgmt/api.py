@@ -71,6 +71,22 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         return tele.snapshot()
     route("GET", "/pipeline/stats", pipeline_stats)
 
+    # ---- window-causal flight recorder (ISSUE 7): the post-mortem
+    #      dump surface. Default: the overlap/bubble analysis + ring
+    #      state; ?format=perfetto returns the Chrome trace-event JSON
+    #      (load in https://ui.perfetto.dev or chrome://tracing) ----
+    async def pipeline_trace(req):
+        rec = getattr(node, "flight_recorder", None)
+        if rec is None:
+            raise ApiError(404, "SERVICE_UNAVAILABLE",
+                           "flight recorder not enabled "
+                           "(EMQX_TPU_TRACE=0?)")
+        if req.query.get("format") == "perfetto":
+            return rec.to_chrome()
+        return {"summary": rec.analyze(),
+                "ring": rec.state()}
+    route("GET", "/pipeline/trace", pipeline_trace)
+
     # ---- clients ----
     async def clients(req):
         items = await mgmt.list_clients()

@@ -89,7 +89,7 @@ class _Handle:
     exactly like the single-chip engine's in-flight batches)."""
 
     __slots__ = ("subs", "built", "tables", "cursors", "enc", "res",
-                 "np_res", "t0", "host_idx")
+                 "np_res", "t0", "host_idx", "trace", "sub_traces")
 
     def __init__(self, subs, built, tables, cursors, enc, host_idx):
         self.subs = subs          # [[Message, ...]] — W=1: one sub-batch
@@ -100,6 +100,8 @@ class _Handle:
         self.host_idx = host_idx  # msg indexes forced host-side (too_long)
         self.res = None
         self.np_res = None
+        self.trace = 0            # flight-recorder window trace (ISSUE 7)
+        self.sub_traces = None    # per-sub trace ids (W=1 on the mesh)
         self.t0: Optional[float] = None
 
 
@@ -742,6 +744,7 @@ class ShardedRouteServer:
                 self.cursors = h.res.new_cursors
         if tele is not None:
             tele.observe_stage("dispatch", time.perf_counter() - t0)
+        self._rec_span(h.trace, "dispatch", t0, track="dispatch")
 
     def _choose_pcap(self, Bp: int) -> Optional[int]:
         """Payload class for a Bp-wide mesh readback, or None for dense.
@@ -823,6 +826,8 @@ class ShardedRouteServer:
                 if tele is not None:
                     tele.observe_stage("materialize",
                                        time.perf_counter() - t0)
+                self._rec_span(h.trace, "materialize", t0,
+                               track="materialize")
                 return
         h.np_res = {
             "matches": np.asarray(r.matches),
@@ -839,6 +844,16 @@ class ShardedRouteServer:
         metrics.inc("pipeline.readback.windows.dense")
         if tele is not None:
             tele.observe_stage("materialize", time.perf_counter() - t0)
+        self._rec_span(h.trace, "materialize", t0, track="materialize")
+
+    def _rec_span(self, trace_id: int, name: str, t0: float, *,
+                  track: str) -> None:
+        """Record one [t0, now] span on the node's flight recorder
+        (no-op when tracing is off or the window carries no trace)."""
+        rec = getattr(self.node, "flight_recorder", None)
+        if rec is not None and trace_id:
+            rec.record(trace_id, name, t0, time.perf_counter(),
+                       track=track)
 
     def finish_sub(self, h: _Handle, k: int,
                    defer: bool = True) -> list[int]:
@@ -864,6 +879,12 @@ class ShardedRouteServer:
                 plan = pool.new_plan(msgs)  # None without a loop
                 if plan is not None:
                     plan.routed_device = True
+                    # causal context → lanes; per-sub when the batcher
+                    # attributed one (fused windows — max_fuse() is 1
+                    # on the mesh today, so this is the W=1 lead trace)
+                    plan.trace = h.sub_traces[k] \
+                        if h.sub_traces and k < len(h.sub_traces) \
+                        else h.trace
         counts: list[int] = []
         for i, msg in enumerate(msgs):
             if i in h.host_idx or bool(np_res["overflow"][i].any()):
@@ -894,6 +915,7 @@ class ShardedRouteServer:
             counts = out
         if tele is not None:
             tele.observe_stage("deliver", time.perf_counter() - t0)
+        self._rec_span(h.trace, "deliver", t0, track="consume")
         return counts
 
     def _collect_clean(self, msg, i: int, np_res, builts):

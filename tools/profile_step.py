@@ -15,6 +15,16 @@ Usage: python tools/profile_step.py [subs] [batch] [window]
 GET /api/v5/pipeline/stats serves): each profiled kernel becomes a stage
 row (per-batch ms) and its warm/compile cost lands in the compile
 accounting, so profiling rounds and bench rounds share one schema.
+
+The FULL schema (ISSUE 7 satellite): the snapshot carries every
+section bench rounds now emit, not just the PR-1 stages/occupancy/
+compiles — `rebuild` (the table build + device upload measured as
+capture/build/swap spans), `readback` (one full-step dense D2H,
+actual bytes), `supervise` (a standalone supervisor's live state —
+armed EMQX_TPU_FAULTS clauses included), `trace` (the flight
+recorder's per-kernel spans + analysis) and `deliver` (present,
+empty — no lane pool in a kernel profile), so snapshot diffs across
+rounds see a stable shape.
 """
 
 import json
@@ -57,8 +67,16 @@ def main():
     B = int(pos[1]) if len(pos) > 1 else 131072
     window = int(pos[2]) if len(pos) > 2 else 16
 
+    from emqx_tpu.broker.supervise import PipelineSupervisor
     from emqx_tpu.broker.telemetry import PipelineTelemetry
+    from emqx_tpu.broker.trace import FlightRecorder
     tele = PipelineTelemetry()
+    # the newer snapshot sections ride this run too: supervise (armed
+    # chaos clauses + breaker state), trace (per-kernel spans)
+    sup = PipelineSupervisor(tele.metrics, telemetry=tele)
+    tele.supervise_state_fn = sup.state
+    rec = FlightRecorder(tele.metrics)
+    tele.recorder = rec
 
     import jax
     import jax.numpy as jnp
@@ -92,6 +110,9 @@ def main():
 
     t0 = time.time()
     shapes = build_shape_tables(rows, lens)
+    # the table build is this run's `rebuild.build` — profiling and
+    # bench rounds share the rebuild-stage schema (ISSUE 7 satellite)
+    tele.observe_rebuild("build", time.time() - t0)
     log(f"build {time.time()-t0:.1f}s buckets={shapes.buckets.shape[0]}")
 
     shared_pct = 50
@@ -110,8 +131,11 @@ def main():
     shared_opts_a = np.ones(n_groups * 8, np.int8)
     subs_tbl = SubTable(sub_start, sub_row, sub_opts, fs_start, fs_slot,
                         shared_start, shared_row, shared_opts_a)
+    t_up = time.time()
     tables = put_tree_chunked(ShapeRouterTables(shapes=shapes, subs=subs_tbl))
     jax.block_until_ready(tables)
+    # the device upload is the profiling analog of `rebuild.swap`
+    tele.observe_rebuild("swap", time.time() - t_up)
     cursors0 = _put_retry(np.zeros(n_groups, np.int32))
     strat = _put_retry(np.int32(STRATEGY_ROUND_ROBIN))
 
@@ -155,7 +179,12 @@ def main():
             return time.time() - t0
         with tele.compile_context(f"profile {stage}"):
             run(2)  # warm/compile (attributed to this kernel's shape)
+        t_meas = time.perf_counter()
         dt = run(window)
+        # each timed kernel is one "window" on the flight recorder:
+        # the trace section shows the measurement timeline per kernel
+        rec.record(rec.new_trace(), stage, t_meas,
+                   time.perf_counter(), track="profile")
         per_ms = dt / (window * batches_per_call) * 1000
         tele.observe_stage(stage, per_ms / 1000.0)
         log(f"{name:34s} {per_ms:8.2f} ms/batch   "
@@ -263,8 +292,32 @@ def main():
     timed(f"FUSED window x{FUSE} (per batch)", f_window,
           topics_per_call=B * FUSE)
 
+    # one full-step DENSE readback: the actual device→host transfer the
+    # broker's materialize stage pays, measured here so the snapshot's
+    # `readback` section carries real bytes/span next to the kernel
+    # times (the digest-closed windows above deliberately avoid D2H)
+    @jax.jit
+    def _step_full(tb, t, l, d, h):
+        return route_step_shapes(tb, cursors0, t, l, d, h, strat,
+                                 fanout_cap=FAN_CAP, slot_cap=SLOT_CAP)
+
+    with tele.compile_context("profile dense_readback"):
+        r_full = _step_full(tables, *staged[0])
+        jax.block_until_ready(r_full.matches)
+    t_mat = time.perf_counter()
+    planes = [np.asarray(x) for x in
+              (r_full.matches, r_full.rows, r_full.opts,
+               r_full.shared_sids, r_full.shared_rows,
+               r_full.shared_opts, r_full.overflow, r_full.occur)]
+    tele.observe_stage("materialize", time.perf_counter() - t_mat)
+    tele.metrics.inc("pipeline.readback.bytes.dense",
+                     sum(p.nbytes for p in planes))
+    tele.metrics.inc("pipeline.readback.windows.dense")
+    log(f"dense readback: {sum(p.nbytes for p in planes) / 1e6:.1f}MB "
+        f"in {(time.perf_counter() - t_mat) * 1000:.1f}ms")
+
     if telemetry_out:
-        snap = tele.snapshot()
+        snap = tele.snapshot(full=True)
         snap["profile"] = {"subs": subs, "batch": B, "window": window,
                            "fuse": FUSE}
         with open(telemetry_out, "w") as f:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Offline bubble analysis of a flight-recorder dump (ISSUE 7).
+
+Input: a Chrome trace-event JSON produced by the window-causal flight
+recorder — `GET /api/v5/pipeline/trace?format=perfetto`,
+`FlightRecorder.dump(path)`, or a file saved from the REST endpoint.
+The same file loads in https://ui.perfetto.dev for the visual timeline;
+this report is the terminal-side triage: per-window stage occupancy,
+the dispatch<->materialize overlap fraction, and the bubble
+attribution (host_stall / device_stall / lane_backpressure) that says
+where a window's time actually went.
+
+Usage:
+    python tools/trace_report.py TRACE.json [--json] [--top N]
+                                 [--windows N]
+
+--json       emit the raw analysis document instead of the table
+--top N      bubble attributions per window (default 3)
+--windows N  only print the last N window rows (default: all)
+
+Exit status 2 when the file holds no analyzable window spans (so CI
+can assert a bench run actually produced a trace).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from emqx_tpu.broker.trace import analyze_chrome  # noqa: E402
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:8.3f}s "
+    return f"{v * 1000:8.3f}ms"
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    if as_json:
+        argv.remove("--json")
+    top = 3
+    last = None
+    if "--top" in argv:
+        i = argv.index("--top")
+        top = int(argv[i + 1])
+        del argv[i:i + 2]
+    if "--windows" in argv:
+        i = argv.index("--windows")
+        last = int(argv[i + 1])
+        del argv[i:i + 2]
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        doc = json.load(f)
+    a = analyze_chrome(doc, top=top)
+    if not a.get("windows"):
+        print("no window spans in trace (tracing off, or the ring "
+              "only holds node events)", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(a, indent=1))
+        return 0
+
+    print(f"windows analyzed: {a['windows']}")
+    ov = a.get("overlap") or {}
+    if ov:
+        print(f"dispatch<->materialize overlap: "
+              f"{ov['dispatch_materialize']:.1%} "
+              f"({_fmt_s(ov['overlapped_s']).strip()} of "
+              f"{_fmt_s(ov['materialize_s']).strip()} readback hidden "
+              f"under another window's dispatch)")
+    occ = a.get("stage_occupancy") or {}
+    if occ:
+        print("\nstage occupancy (share of its window's span):")
+        for name, row in sorted(occ.items(),
+                                key=lambda kv: -kv[1]["total_s"]):
+            print(f"  {name:18s} total {_fmt_s(row['total_s'])} "
+                  f" mean {row['mean_frac']:.1%} of window")
+    bub = a.get("bubbles") or {}
+    if bub:
+        print("\nbubbles (uncovered window time, by attribution):")
+        for k, v in bub.get("top", []):
+            print(f"  {k:18s} {_fmt_s(v)}")
+        print(f"  {'total':18s} {_fmt_s(bub['total_s'])}")
+    rows = a.get("last_windows") or []
+    if last is not None:
+        rows = rows[-last:]
+    if rows:
+        print(f"\nper-window (last {len(rows)}):")
+        for r in rows:
+            stages = " ".join(
+                f"{k}={v * 1000:.2f}ms"
+                for k, v in sorted(r["stages"].items(),
+                                   key=lambda kv: -kv[1])[:4])
+            bubbles = " ".join(f"{k}={v * 1000:.2f}ms"
+                               for k, v in r["bubbles"])
+            print(f"  w{r['trace_id']:<6d} span "
+                  f"{_fmt_s(r['span_s'])}  {stages}")
+            if bubbles:
+                print(f"    {'bubbles:':8s} {bubbles}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
