@@ -33,13 +33,20 @@ restores the wait), BENCH_FANOUT (=0 skips the delivery-lane fan-out
 row; tools/fanout_bench.py knobs FANOUT_*), BENCH_CHECKPOINT /
 BENCH_RESUME (resumable phase ladder: each phase's JSON commits to disk
 as it completes and a restarted bench resumes from the checkpoint —
-BENCH_RESUME=0 starts fresh).
+BENCH_RESUME=0 starts fresh), BENCH_HBM (=0 skips the HBM capacity
+forecast committed right after phase0; tools/hbm_report.py knobs
+BENCH_HBM_SIZES / BENCH_HBM_HEADROOM).
 
 Diagnosability: every e2e phase snapshots the node's pipeline telemetry
 (stage timings, batch occupancy, compile counts —
 broker.telemetry.PipelineTelemetry.snapshot()) into the result row, and
 the newest snapshot is embedded in the error JSON too, so a round that
-dies mid-flight still reports WHERE the pipeline spent its time.
+dies mid-flight still reports WHERE the pipeline spent its time. The
+memory story rides the same way (ISSUE 8): `hbm_forecast` (the fitted
+per-subscription bytes + subscription ceiling per HBM budget),
+`phase_memory` (per-phase backend memory_stats, checkpointed/restored
+like the wall seconds) and `memory` (the newest HBM-ledger section)
+land in the merged AND error JSON.
 """
 
 import json
@@ -76,10 +83,41 @@ _PHASE_WALL: dict = {}
 # the other place dead rounds' minutes vanished
 _RELAY_WAIT_S = 0.0
 
+# per-phase memory accounting (ISSUE 8 satellite): each phase stamps
+# the backend's memory_stats() (when the runtime exposes it — TPU yes,
+# XLA CPU no) at completion, success or failure; rides the checkpoint,
+# the merged JSON and the error JSON like _PHASE_WALL, so a window
+# that OOMs mid-plan shows which phase's allocations were live
+_PHASE_MEM: dict = {}
+# newest node-side memory row (HBM ledger section + device stats, set
+# by run_e2e) — the `memory` the error JSON carries
+_LAST_MEMORY = None
+# the HBM capacity forecast (tools/hbm_report.py), committed right
+# after phase0 so even a round whose throughput phases all die still
+# reports a measured memory headline
+_HBM_FORECAST = None
+
+
+def _mem_row(node=None):
+    """One memory accounting row: the HBM ledger's `memory` section
+    when `node` carries a ledger (it embeds the device stats), else
+    the bare backend memory_stats(); None when neither exists."""
+    try:
+        from emqx_tpu.broker.hbm_ledger import device_memory_stats
+        ledger = getattr(node, "hbm_ledger", None) \
+            if node is not None else None
+        if ledger is not None:
+            return ledger.section()
+        dev = device_memory_stats()
+        return {"device": dev} if dev else None
+    except Exception:  # noqa: BLE001 — accounting must never kill data
+        return None
+
 
 class _phase_clock:
     """Context manager stamping one phase's wall seconds into
-    _PHASE_WALL whether the phase returns or raises."""
+    _PHASE_WALL (and its end-of-phase memory row into _PHASE_MEM)
+    whether the phase returns or raises."""
 
     def __init__(self, name: str):
         self.name = name
@@ -90,6 +128,9 @@ class _phase_clock:
 
     def __exit__(self, *exc):
         _PHASE_WALL[self.name] = round(time.time() - self.t0, 1)
+        mem = _mem_row()
+        if mem:
+            _PHASE_MEM[self.name] = mem
         return False
 
 
@@ -134,6 +175,15 @@ def _error_json(error) -> str:
         doc["phase_wall_s"] = dict(_PHASE_WALL)
     if _RELAY_WAIT_S:
         doc["relay_wait_s"] = round(_RELAY_WAIT_S, 1)
+    # the memory story (ISSUE 8 satellite): per-phase device stats,
+    # the newest ledger section, and the capacity forecast all ride
+    # the error JSON — a dead round still commits a memory headline
+    if _PHASE_MEM:
+        doc["phase_memory"] = dict(_PHASE_MEM)
+    if _LAST_MEMORY:
+        doc["memory"] = _LAST_MEMORY
+    if _HBM_FORECAST:
+        doc["hbm_forecast"] = _HBM_FORECAST
     lm = _last_measured()
     if lm:
         doc["last_measured"] = lm
@@ -190,6 +240,9 @@ def _ckpt_load(sig: dict) -> dict:
         # resumed phases keep their measured wall seconds — the merged
         # JSON's accounting spans the dying run AND its resume
         _PHASE_WALL.update(doc.get("walls") or {})
+        # ... and their end-of-phase memory rows (ISSUE 8): the dying
+        # run's device memory_stats survive into the merged JSON
+        _PHASE_MEM.update(doc.get("mem") or {})
         # likewise the dying run's relay wait (the BENCH_r05 540s):
         # _ckpt_load runs after THIS run's backend probe has already
         # set _RELAY_WAIT_S, so the two accumulate
@@ -212,6 +265,7 @@ def _ckpt_put(name: str, value, sig: dict, phases: dict) -> None:
         with open(tmp, "w") as f:
             json.dump({"sig": sig, "ts": time.time(),
                        "phases": phases, "walls": _PHASE_WALL,
+                       "mem": _PHASE_MEM,
                        "relay_wait_s": round(_RELAY_WAIT_S, 1)}, f)
         os.replace(tmp, path)
     except Exception as e:  # noqa: BLE001 — checkpointing is insurance,
@@ -1408,7 +1462,7 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             if jitter else None,
         }
 
-    global _LAST_TELEMETRY
+    global _LAST_TELEMETRY, _LAST_MEMORY
     try:
         return asyncio.run(go())
     finally:
@@ -1420,6 +1474,11 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
                 _LAST_TELEMETRY = node.pipeline_telemetry.snapshot()
             except Exception:  # noqa: BLE001
                 pass
+            # the newest HBM-ledger section (ISSUE 8): what was on the
+            # device when the run ended, success or crash
+            mem = _mem_row(node)
+            if mem:
+                _LAST_MEMORY = mem
 
 
 def main():
@@ -1634,6 +1693,55 @@ def main():
                 log(f"phase0 failed: {type(e).__name__}: {e}")
             finally:
                 signal.alarm(0)
+
+    # HBM capacity forecast (ISSUE 8): fit per-subscription byte costs
+    # from ledgered snapshot-table uploads at several sizes and
+    # extrapolate the subscription ceiling per HBM budget (16GB v5e-1
+    # headline). Committed RIGHT AFTER phase0 — seconds of CPU, no
+    # relay involved (subprocess with the axon pool stripped, like the
+    # skew/churn/fanout rows) — so even a round whose throughput phases
+    # all die still reports a measured memory headline.
+    global _HBM_FORECAST
+    if "hbm" in phases:
+        _HBM_FORECAST = phases["hbm"]
+        log("hbm: resumed from checkpoint")
+    elif os.environ.get("BENCH_HBM", "1") != "0":
+        try:
+            senv = dict(os.environ)
+            senv.pop("PALLAS_AXON_POOL_IPS", None)
+            senv["JAX_PLATFORMS"] = "cpu"
+            with _phase_clock("hbm"):
+                sp = subprocess.run(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(
+                         os.path.abspath(__file__)),
+                         "tools", "hbm_report.py")],
+                    capture_output=True, text=True, env=senv,
+                    timeout=int(os.environ.get("BENCH_HBM_TIMEOUT_S",
+                                               600)))
+            for ln in reversed(sp.stdout.splitlines()):
+                if ln.strip().startswith("{"):
+                    _HBM_FORECAST = json.loads(ln)
+                    break
+            if _HBM_FORECAST is not None:
+                if sp.returncode != 0:
+                    # the report exits 2 when a measure point's arrays
+                    # did not release (ledger leak) — the forecast
+                    # numbers still print, but they are leak-tainted
+                    # and must not read as a clean measurement
+                    _HBM_FORECAST["release_proof_failed"] = True
+                    log(f"hbm forecast: release proof FAILED "
+                        f"(rc={sp.returncode}) — forecast tainted")
+                _ckpt_put("hbm", _HBM_FORECAST, sig, phases)
+                log(f"hbm forecast: "
+                    f"{_HBM_FORECAST['fit']['per_sub_bytes']} B/sub -> "
+                    f"{_HBM_FORECAST['headline']['ceiling_subs']} subs "
+                    f"ceiling at {_HBM_FORECAST['headline']['budget']}")
+            else:
+                log(f"hbm forecast produced no JSON "
+                    f"(rc={sp.returncode}): {sp.stderr[-200:]}")
+        except Exception as e:  # noqa: BLE001 — best-effort pre-phase
+            log(f"hbm forecast failed: {type(e).__name__}: {e}")
 
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", 2400)))
@@ -1913,6 +2021,16 @@ def main():
                 result["phase_wall_s"] = dict(_PHASE_WALL)
             if _RELAY_WAIT_S:
                 result["relay_wait_s"] = round(_RELAY_WAIT_S, 1)
+            # the memory story (ISSUE 8): the capacity forecast next to
+            # the throughput headline, per-phase device stats, and the
+            # newest ledger section — the same fields the error JSON
+            # carries, so success and failure rounds compare directly
+            if _HBM_FORECAST:
+                result["hbm_forecast"] = _HBM_FORECAST
+            if _PHASE_MEM:
+                result["phase_memory"] = dict(_PHASE_MEM)
+            if _LAST_MEMORY:
+                result["memory"] = _LAST_MEMORY
             print(json.dumps(result), flush=True)
             # the merged JSON is committed: the checkpoint has served
             # its purpose (a stale one would pollute the next round)

@@ -77,7 +77,11 @@ class SysBroker:
         stage, ISSUE 5) / `pipeline/supervise` (fault-domain
         supervision: breaker states, ladder rung, ISSUE 6) /
         `pipeline/trace` (window-causal flight recorder: ring state +
-        dispatch↔materialize overlap + bubble attribution, ISSUE 7)."""
+        dispatch↔materialize overlap + bubble attribution, ISSUE 7) /
+        `pipeline/memory` (HBM ledger: per-category device bytes, pin
+        ages, backend memory_stats cross-check, ISSUE 8) /
+        `pipeline/program_costs` (jit-program cost registry: compile
+        wall per class, flops/bytes where analyzed, ISSUE 8)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -93,7 +97,8 @@ class SysBroker:
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
-                        "deliver", "supervise", "trace"):
+                        "deliver", "supervise", "trace", "memory",
+                        "program_costs"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

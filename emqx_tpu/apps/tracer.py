@@ -75,12 +75,13 @@ class Tracer:
               tag="tracer")
         h.add("session.subscribed", self.on_session_subscribed, tag="tracer")
         h.add("batch.slow", self.on_batch_slow, tag="tracer")
+        h.add("pipeline.pin_stale", self.on_pin_stale, tag="tracer")
         return self
 
     def unload(self) -> None:
         for hp in ("message.publish", "client.connected",
                    "client.disconnected", "session.subscribed",
-                   "batch.slow"):
+                   "batch.slow", "pipeline.pin_stale"):
             self.node.hooks.delete(hp, "tracer")
         for t in self._traces.values():
             t.close()
@@ -166,6 +167,16 @@ class Tracer:
         for t in self._traces.values():
             if t.kind == "slow_batch":
                 t.write(line)
+
+    def on_pin_stale(self, info: dict) -> None:
+        """`pipeline.pin_stale` hook (broker.hbm_ledger, ISSUE 8): a
+        dispatch handle has pinned its snapshot longer than
+        EMQX_TPU_PIN_WARN_WINDOWS prepared windows — stale pins
+        silently block snapshot swaps AND hold the old snapshot's
+        HBM, so the leak is logged the moment it crosses the
+        threshold instead of surfacing as a mystery rebuild stall."""
+        log.warning("STALE_PIN %s",
+                    " ".join(f"{k}={info[k]}" for k in sorted(info)))
 
     def on_session_subscribed(self, clientinfo: dict, topic: str,
                               subopts: dict) -> None:

@@ -83,6 +83,19 @@ DEFAULTS: dict = {
         "trace_sample": None,
         # flight-recorder ring capacity, in spans
         "trace_ring": 4096,
+        # None = resolve via EMQX_TPU_HBM_LEDGER, then default-on
+        # (broker/hbm_ledger.resolve_hbm_ledger); false restores the
+        # pre-ISSUE-8 untracked behavior exactly (no ledger object,
+        # no `memory` telemetry section) — the A/B baseline. A
+        # baked-in bool here would shadow the env knob through the
+        # defaults merge.
+        "hbm_ledger": None,
+        # stale-pin sentinel threshold in windows (None =
+        # EMQX_TPU_PIN_WARN_WINDOWS, then 64; must be > 0): a dispatch
+        # handle pinning its snapshot longer than this fires the
+        # pipeline.memory.pin_warnings counter + pipeline.pin_stale
+        # hook + a stale_pin flight-recorder event
+        "pin_warn_windows": None,
         "perf": {"trie_compaction": True},
     },
     "zones": {},                 # zone name -> {mqtt: {...}} overrides

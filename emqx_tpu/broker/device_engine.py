@@ -491,7 +491,7 @@ class DeviceRouteEngine:
                  dedup: Optional[bool] = None,
                  compact_readback: Optional[bool] = None,
                  delta_overlay: Optional[bool] = None,
-                 supervisor=None):
+                 supervisor=None, ledger=None):
         self.node = node
         self.broker = node.broker
         self.router = node.broker.router
@@ -614,6 +614,14 @@ class DeviceRouteEngine:
             self.sup.register_probe("dispatch", self._probe_dispatch)
             self.sup.register_probe("materialize",
                                     self._probe_materialize)
+
+        # HBM ledger (ISSUE 8): every persistent device allocation this
+        # engine makes — snapshot tables/cursors, per-version delta
+        # overlays — registers through _hold; dispatch handles pin the
+        # window clock for the stale-pin sentinel. None (knob off)
+        # restores the untracked behavior exactly.
+        self.ledger = ledger if ledger is not None \
+            else getattr(node, "hbm_ledger", None)
 
         # wire change notifications
         self.router.on_route_change = self.note_route_change
@@ -1045,9 +1053,17 @@ class DeviceRouteEngine:
         cur = np.zeros(max(1, len(cursors0)), np.int32)
         if cursors0:
             cur[:len(cursors0)] = cursors0
-        dev_tables = jax.device_put(tables)
-        dev_cursors = jax.device_put(cur)
+        dev_tables = self._hold("snapshot_tables", jax.device_put(tables),
+                                owner=f"sid{b.sid}")
+        dev_cursors = self._hold("snapshot_cursors", jax.device_put(cur))
         return b, dev_tables, dev_cursors, rich
+
+    def _hold(self, category: str, tree, owner: Optional[str] = None):
+        """Register a persistent device allocation with the HBM ledger
+        (ISSUE 8); identity passthrough when the ledger is off."""
+        if self.ledger is not None:
+            return self.ledger.hold(category, tree, owner=owner)
+        return tree
 
     def _apply_build(self, result, journal) -> None:
         """Swap a finished build in and rebase churn tracking onto it by
@@ -1492,7 +1508,10 @@ class DeviceRouteEngine:
                                 level_cap=self.max_levels,
                                 fan_per_row=_DELTA_FAN_PER_ROW)
         import jax
-        dev = jax.device_put(dt)
+        # each overlay version is its own ledgered allocation: pinned
+        # versions show up as distinct owners until their handles drain
+        dev = self._hold("delta_overlay", jax.device_put(dt),
+                         owner=f"v{self._overlay_clock}")
         self._overlay = _Overlay(dev, frozenset(fid_set), row_of, seg_of,
                                  hostfan, self._overlay_clock, cap,
                                  len(entries))
@@ -2200,6 +2219,12 @@ class DeviceRouteEngine:
             h.pcap = self._gate_compact(Wp, Bp, h.plan, gate_cold,
                                         h.delta)
         self._outstanding += 1
+        if self.ledger is not None:
+            # pin sentinel (ISSUE 8): this handle pins the snapshot —
+            # a pin outliving pin_warn_windows prepared windows fires
+            # the stale-pin warning (counter + hook + recorder event)
+            self.ledger.note_window()
+            self.ledger.pin(id(h), h)
         self.node.metrics.inc("routing.device.windows")
         self.node.metrics.inc("routing.device.window_subs", W)
         tele = getattr(self.node, "pipeline_telemetry", None)
@@ -2357,7 +2382,10 @@ class DeviceRouteEngine:
                 cursors = r.new_cursors
                 outs.append(r)
             if self._tables is tables:   # no swap raced this dispatch
-                self._cursors = cursors
+                # adopted cursors are fresh jit outputs, not the held
+                # device_put array — re-register so the ledger's
+                # cursor bytes track the LIVE array across dispatches
+                self._cursors = self._hold("snapshot_cursors", cursors)
             h.res = type(outs[0])(*[jnp.stack([getattr(o, f)
                                               for o in outs])
                                     for f in outs[0]._fields])
@@ -2432,7 +2460,8 @@ class DeviceRouteEngine:
                 res = type(res)(*[jnp.stack([getattr(res, f)])
                                   for f in res._fields])
         if self._tables is tables:   # no swap raced this dispatch
-            self._cursors = res.new_cursors[-1]
+            self._cursors = self._hold("snapshot_cursors",
+                                       res.new_cursors[-1])
         self._warm_classes.add(warm_key)
         h.res = res
 
@@ -3040,6 +3069,8 @@ class DeviceRouteEngine:
         if h.refs <= 0:
             h.built = None
             self._outstanding -= 1
+            if self.ledger is not None:
+                self.ledger.unpin(id(h))
             if self._building:
                 self._try_swap()
 
@@ -3050,6 +3081,8 @@ class DeviceRouteEngine:
             h.refs = 0
             h.built = None
             self._outstanding -= 1
+            if self.ledger is not None:
+                self.ledger.unpin(id(h))
             if self._building:
                 self._try_swap()
 

@@ -1,0 +1,413 @@
+"""HBM ledger: per-category accounting of persistent device allocations.
+
+The 10M-subscription north star is ultimately an HBM-budget question,
+yet until ISSUE 8 nothing accounted for device memory: snapshot tables,
+delta-overlay versions and mesh shard tables were all `jax.device_put`
+into the void. This module is the third leg of the observability stack
+— PR 1 instrumented time (stage histograms), PR 7 causality (flight-
+recorder spans), this instruments **space**:
+
+- **`ledger.hold(category, pytree, owner=...)`** — the thin wrapper
+  every persistent `device_put` site routes through
+  (`broker/device_engine.py`, `parallel/serving.py`,
+  `parallel/sharded.py`). It walks the pytree, sums leaf `.nbytes`
+  into the category's live-bytes gauge (+ peak watermark), attaches a
+  `weakref.finalize` per leaf and returns the pytree unchanged —
+  release is AUTOMATIC when the arrays are garbage-collected (a
+  snapshot swap dropping the old tables shows up as a release without
+  any explicit call), so the ledger can never wedge a swap. Leaves are
+  deduplicated by identity: holding an aliased array twice counts it
+  once.
+- **Pin sentinel** — dispatch handles pin the snapshot they ran
+  against (the engine defers swaps while any handle is outstanding);
+  a leaked handle therefore silently blocks swaps AND holds the old
+  snapshot's HBM. `pin()`/`unpin()` track each in-flight handle
+  against the window clock (`note_window()`), and a pin older than
+  `broker.pin_warn_windows` / `EMQX_TPU_PIN_WARN_WINDOWS` windows
+  fires once: the `pipeline.memory.pin_warnings` counter, the
+  `pipeline.pin_stale` hook (apps/tracer logs it at WARNING) and a
+  `stale_pin` instant event on the flight recorder's timeline (the
+  same surface supervise trips land on).
+- **`section()`** — the `memory` section of
+  `PipelineTelemetry.snapshot()`, published by all four exporters
+  ($SYS `pipeline/memory`, Prometheus/StatsD via the Stats gauges
+  below, REST `GET /api/v5/pipeline/memory`): per-category live
+  bytes / peak watermarks / hold counts / owners, pin ages in
+  windows, and — where the backend exposes it —
+  `jax.local_devices()[0].memory_stats()` (bytes_in_use) as the
+  cross-check (`accounted_fraction` = ledger live / bytes_in_use; a
+  fraction well below 1 under load means allocations are bypassing
+  the ledger — the gate `tools/check_hbm_hygiene.py` catches the
+  static cases).
+
+Counters in the shared Metrics registry (every exporter carries them):
+`pipeline.memory.holds` / `pipeline.memory.releases` (+ the byte
+totals `pipeline.memory.hold_bytes` / `pipeline.memory.release_bytes`)
+and `pipeline.memory.pin_warnings`. The count pair is symmetric BY
+LEAF — `holds` counts newly-accounted array leaves exactly as
+`releases` counts leaf finalizer fires, so `holds - releases` is the
+live leaf count and the pair reconciles to zero at quiescence (the
+byte pair reconciles the same way). The per-category `holds` row in
+`section()` is a different number on purpose: it counts `hold()`
+registration calls (a cursor re-adopt that added no new leaves still
+counts), i.e. allocation *activity*, not leaf population. Point-in-time gauges ride the
+Stats table via `stats_fun` (Prometheus gauge family, StatsD `|g`,
+`$SYS .../stats/`): `pipeline.memory.live_bytes`,
+`pipeline.memory.peak_bytes`, `pipeline.memory.pinned_handles`,
+`pipeline.memory.max_pin_age_windows`.
+
+Knob: `broker.hbm_ledger` / `EMQX_TPU_HBM_LEDGER` (config beats env
+beats default-on). `=0` restores the untracked behavior EXACTLY — no
+ledger object anywhere, `device_put` results flow through untouched,
+no `memory` section — the A/B baseline `tests/test_hbm_ledger.py`
+asserts. Hot-path cost at default settings is one dict store + one
+dict pop + one counter bump per window (the <1% guard microbench in
+the tests bounds it); `hold()` itself runs only at build/overlay/
+cursor-adopt time.
+
+`tools/hbm_report.py` fits per-subscription byte costs from this
+ledger at several table sizes and extrapolates the subscription
+ceiling per HBM budget — the capacity-forecast leg of ISSUE 8.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Optional
+
+SCHEMA = "emqx_tpu.memory/v1"
+
+
+def resolve_hbm_ledger(configured=None) -> bool:
+    """The one ledger-knob resolution: config (``broker.hbm_ledger``)
+    beats ``EMQX_TPU_HBM_LEDGER`` beats default-on. ``=0`` restores the
+    pre-ISSUE-8 untracked behavior exactly (no ledger anywhere) — the
+    A/B baseline."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_HBM_LEDGER", "1") \
+        not in ("0", "false", "off")
+
+
+def resolve_pin_warn_windows(configured=None) -> int:
+    """Stale-pin threshold, in windows: config
+    (``broker.pin_warn_windows``) beats ``EMQX_TPU_PIN_WARN_WINDOWS``
+    beats the built-in 64 (a healthy handle lives ~pipeline-depth
+    windows, i.e. single digits; 64 is an order of magnitude of slack).
+    Must be a positive integer — anything else is a deployment error
+    worth failing loudly on."""
+    if configured is None:
+        env = os.environ.get("EMQX_TPU_PIN_WARN_WINDOWS")
+        if env is None:
+            return 64
+        configured = env
+    try:
+        val = int(configured)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"EMQX_TPU_PIN_WARN_WINDOWS={configured!r} is not an integer")
+    if val <= 0:
+        raise ValueError(
+            f"EMQX_TPU_PIN_WARN_WINDOWS must be > 0, got {val}")
+    return val
+
+
+def device_memory_stats() -> Optional[dict]:
+    """`memory_stats()` of the first local device, JSON-safe, or None
+    where the backend does not expose it (XLA CPU returns None; TPU
+    runtimes report bytes_in_use / peak_bytes_in_use / bytes_limit).
+    Never raises and never forces a backend init of its own — callers
+    (telemetry snapshot, bench rows) treat None as 'not available'."""
+    import sys
+    if "jax" not in sys.modules:
+        return None     # never force a jax import from telemetry
+    try:
+        import jax
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:   # noqa: BLE001 — telemetry must never raise
+        return None
+    if not ms:
+        return None
+    return {k: int(v) for k, v in ms.items()
+            if isinstance(v, (int, float))}
+
+
+def total_bytes_in_use() -> Optional[int]:
+    """Summed `bytes_in_use` over ALL local devices, or None where the
+    backend exposes no memory_stats. The accounted-fraction denominator:
+    ledger leaves are (possibly sharded) global arrays whose `.nbytes`
+    spans every shard, so comparing against one device's bytes_in_use
+    would overstate the fraction by the shard count on a mesh."""
+    import sys
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+        total = 0
+        seen = False
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms and "bytes_in_use" in ms:
+                total += int(ms["bytes_in_use"])
+                seen = True
+        return total if seen else None
+    except Exception:   # noqa: BLE001 — telemetry must never raise
+        return None
+
+
+def _leaves(tree):
+    """Yield the array leaves (anything with .nbytes) of a pytree of
+    tuples/NamedTuples/lists/dicts — structure-only walk, no jax import
+    (the ledger must stay importable on nodes without jax)."""
+    if tree is None:
+        return
+    if hasattr(tree, "nbytes"):
+        yield tree
+    elif isinstance(tree, (tuple, list)):
+        for x in tree:
+            yield from _leaves(x)
+    elif isinstance(tree, dict):
+        for x in tree.values():
+            yield from _leaves(x)
+
+
+class _Cat:
+    """One category's accounting row."""
+
+    __slots__ = ("live_bytes", "peak_bytes", "holds", "releases",
+                 "live_leaves", "owners")
+
+    def __init__(self):
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.holds = 0
+        self.releases = 0
+        self.live_leaves = 0
+        self.owners: dict[str, int] = {}
+
+
+class HbmLedger:
+    """Per-node device-memory ledger (see module docstring).
+
+    Thread-safety: ``hold()`` runs on the loop AND executor threads
+    (build/warm/mesh threads), and the weakref finalizers fire on
+    whatever thread drops the last reference — all category mutation
+    is under one lock. ``pin``/``unpin``/``note_window`` are the only
+    per-window operations and are plain dict/int ops under the GIL.
+    """
+
+    def __init__(self, metrics=None, *, pin_warn_windows=None,
+                 hooks=None, recorder=None):
+        self.metrics = metrics
+        self.hooks = hooks
+        # flight recorder (ISSUE 7): stale-pin instant events land on
+        # the pinned window's causal timeline (node scope when unknown)
+        self.recorder = recorder
+        self.pin_warn_windows = resolve_pin_warn_windows(pin_warn_windows)
+        # RLock, not Lock: weakref finalizers run at arbitrary
+        # allocation points (cyclic GC), including while THIS thread
+        # is inside a locked region — a reentrant _release must not
+        # deadlock against the hold()/section() that triggered it
+        self._lock = threading.RLock()
+        self._cats: dict[str, _Cat] = {}
+        # true global high-water mark of summed live bytes — NOT the
+        # sum of per-category peaks (categories peak at different
+        # times; that sum can report a total that never occurred)
+        self._peak_bytes = 0
+        # leaf id -> (category, nbytes, owner): the live set the
+        # finalizers release from; id() identity dedups aliased holds
+        self._live: dict[int, tuple[str, int, Optional[str]]] = {}
+        # pinned dispatch handles: key -> [start_window, handle, warned]
+        self._pins: dict[int, list] = {}
+        self._window_clock = 0
+        self.pin_warnings = 0
+
+    # ---- holds -----------------------------------------------------------
+    def hold(self, category: str, tree, owner: Optional[str] = None):
+        """Register a persistent device pytree under `category` and
+        return it unchanged. Every leaf gets a weakref finalizer, so
+        the bytes release automatically when the arrays die — no
+        explicit release call exists, by design (an unpaired release
+        API is exactly the leak class this ledger hunts)."""
+        total = 0
+        new_leaves = 0
+        with self._lock:
+            cat = self._cats.setdefault(category, _Cat())
+            for leaf in _leaves(tree):
+                lid = id(leaf)
+                if lid in self._live:
+                    continue        # aliased leaf: already accounted
+                nb = int(leaf.nbytes)
+                try:
+                    weakref.finalize(leaf, self._release, lid)
+                except TypeError:
+                    # not weakref-able (exotic leaf): skip rather than
+                    # leak a live entry that can never release
+                    continue
+                self._live[lid] = (category, nb, owner)
+                cat.live_bytes += nb
+                cat.live_leaves += 1
+                total += nb
+                new_leaves += 1
+                if owner is not None:
+                    cat.owners[owner] = cat.owners.get(owner, 0) + nb
+            cat.peak_bytes = max(cat.peak_bytes, cat.live_bytes)
+            cat.holds += 1
+            self._peak_bytes = max(
+                self._peak_bytes,
+                sum(c.live_bytes for c in self._cats.values()))
+        # per-LEAF, matching _release's per-finalizer count: holds -
+        # releases == live leaves, so the pair reconciles to zero like
+        # the byte pair does (the category row's `holds` stays a
+        # per-call activity count — see module docstring)
+        if self.metrics is not None and new_leaves:
+            self.metrics.inc("pipeline.memory.holds", new_leaves)
+            self.metrics.inc("pipeline.memory.hold_bytes", total)
+        return tree
+
+    def _release(self, lid: int) -> None:
+        """Finalizer: one leaf died — return its bytes."""
+        with self._lock:
+            entry = self._live.pop(lid, None)
+            if entry is None:
+                return
+            category, nb, owner = entry
+            cat = self._cats.get(category)
+            if cat is not None:
+                cat.live_bytes -= nb
+                cat.live_leaves -= 1
+                cat.releases += 1
+                if owner is not None:
+                    left = cat.owners.get(owner, 0) - nb
+                    if left > 0:
+                        cat.owners[owner] = left
+                    else:
+                        cat.owners.pop(owner, None)
+        if self.metrics is not None:
+            self.metrics.inc("pipeline.memory.releases")
+            self.metrics.inc("pipeline.memory.release_bytes", nb)
+
+    def live_bytes(self, category: Optional[str] = None) -> int:
+        with self._lock:
+            if category is not None:
+                cat = self._cats.get(category)
+                return cat.live_bytes if cat is not None else 0
+            return sum(c.live_bytes for c in self._cats.values())
+
+    def live_leaves(self) -> int:
+        """Live finalizer-tracked leaves — the weakref-leak probe the
+        lifecycle tests assert returns to baseline after a swap."""
+        with self._lock:
+            return len(self._live)
+
+    # ---- pin sentinel (ISSUE 8 satellite) --------------------------------
+    def note_window(self) -> None:
+        """One prepared dispatch window: advance the pin clock and fire
+        the stale-pin sentinel for any handle pinned past the
+        threshold. Hot path: one int bump plus a scan of the (pipeline-
+        depth-sized) pin dict."""
+        self._window_clock += 1
+        w = self._window_clock
+        warn = self.pin_warn_windows
+        for key, rec in list(self._pins.items()):
+            if rec[2] or w - rec[0] <= warn:
+                continue
+            rec[2] = True
+            self.pin_warnings += 1
+            age = w - rec[0]
+            handle = rec[1]() if rec[1] is not None else None
+            trace = getattr(handle, "trace", 0) or 0
+            if self.metrics is not None:
+                self.metrics.inc("pipeline.memory.pin_warnings")
+            if self.recorder is not None:
+                try:
+                    self.recorder.event(
+                        trace, "stale_pin", track="memory",
+                        meta={"age_windows": age, "warn_windows": warn})
+                except Exception:  # noqa: BLE001 — sentinel best-effort
+                    pass
+            if self.hooks is not None:
+                try:
+                    self.hooks.run("pipeline.pin_stale",
+                                   ({"age_windows": age,
+                                     "warn_windows": warn,
+                                     "trace": trace},))
+                except Exception:  # noqa: BLE001 — sentinel best-effort
+                    pass
+
+    def pin(self, key: int, handle=None) -> None:
+        """A dispatch handle went in flight: it pins its snapshot (the
+        engine defers swaps while any pin is outstanding). Held by
+        weakref only — a leaked handle (the exact case the sentinel
+        hunts) must stay collectable, or the ledger itself would
+        retain the snapshot HBM it is instrumenting."""
+        try:
+            ref = weakref.ref(handle) if handle is not None else None
+        except TypeError:
+            ref = None
+        self._pins[key] = [self._window_clock, ref, False]
+
+    def unpin(self, key: int) -> None:
+        self._pins.pop(key, None)
+
+    def pin_state(self) -> dict:
+        w = self._window_clock
+        # snapshot: pin()/unpin() mutate from loop + executor threads
+        ages = [w - rec[0] for rec in list(self._pins.values())]
+        return {"outstanding": len(ages),
+                "max_age_windows": max(ages) if ages else 0,
+                "warn_windows": self.pin_warn_windows,
+                "warnings": self.pin_warnings,
+                "window_clock": w}
+
+    # ---- export surfaces -------------------------------------------------
+    def section(self) -> dict:
+        """The `memory` section of `PipelineTelemetry.snapshot()` —
+        the one schema shared by $SYS `pipeline/memory`,
+        `GET /api/v5/pipeline/memory`, bench rows and
+        `tools/hbm_report.py`."""
+        with self._lock:
+            cats = {}
+            for name in sorted(self._cats):
+                c = self._cats[name]
+                row = {"live_bytes": c.live_bytes,
+                       "peak_bytes": c.peak_bytes,
+                       "holds": c.holds, "releases": c.releases,
+                       "live_leaves": c.live_leaves}
+                if c.owners:
+                    row["owners"] = dict(sorted(c.owners.items()))
+                cats[name] = row
+            live = sum(c.live_bytes for c in self._cats.values())
+            peak = self._peak_bytes
+        out = {"schema": SCHEMA, "live_bytes": live, "peak_bytes": peak,
+               "categories": cats, "pins": self.pin_state()}
+        dev = device_memory_stats()
+        if dev is not None:
+            out["device"] = dev
+            # the backend cross-check: how much of what the devices
+            # report in use the ledger can name. Well below 1 under
+            # load = allocations bypassing the ledger. Denominator is
+            # summed over ALL local devices: ledger leaves are global
+            # arrays, so one device's bytes_in_use would overstate the
+            # fraction by the shard count on a mesh.
+            in_use = total_bytes_in_use()
+            if in_use:
+                out["accounted_fraction"] = round(live / in_use, 4)
+        return out
+
+    def stats_fun(self, stats) -> None:
+        """Point-in-time gauges for the Stats table (sampled each
+        sweep): the Prometheus/StatsD/$SYS-stats carriers of live
+        state a counter can't express."""
+        with self._lock:
+            live = sum(c.live_bytes for c in self._cats.values())
+            peak = self._peak_bytes
+        ps = self.pin_state()
+        stats.setstat("pipeline.memory.live_bytes", live)
+        stats.setstat("pipeline.memory.peak_bytes", peak)
+        stats.setstat("pipeline.memory.pinned_handles",
+                      ps["outstanding"])
+        stats.setstat("pipeline.memory.max_pin_age_windows",
+                      ps["max_age_windows"])

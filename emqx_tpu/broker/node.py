@@ -102,6 +102,25 @@ class Node:
             # timeline shows WHEN the ladder moved relative to the
             # windows that tripped it
             self.supervisor.recorder = self.flight_recorder
+        # HBM ledger (ISSUE 8): per-category accounting of persistent
+        # device allocations (snapshot tables/cursors, delta-overlay
+        # versions, mesh shard tables) + the stale-pin sentinel. Both
+        # engines register their device_put sites through it;
+        # telemetry.snapshot() gains the `memory` section all four
+        # exporters publish. broker.hbm_ledger / EMQX_TPU_HBM_LEDGER
+        # =0 restores the untracked behavior exactly (self.hbm_ledger
+        # stays None everywhere).
+        self.hbm_ledger = None
+        from emqx_tpu.broker.hbm_ledger import (HbmLedger,
+                                                resolve_hbm_ledger)
+        if resolve_hbm_ledger(perf.get("hbm_ledger")) \
+                and (use_device or mc.get("enable")):
+            self.hbm_ledger = HbmLedger(
+                self.metrics,
+                pin_warn_windows=perf.get("pin_warn_windows"),
+                hooks=self.hooks, recorder=self.flight_recorder)
+            self.pipeline_telemetry.ledger = self.hbm_ledger
+            self.stats.register_stats_fun(self.hbm_ledger.stats_fun)
         # session-affine delivery lanes (ISSUE 5): the overlapped egress
         # stage both engines' consume hands plans to. 0 lanes (config
         # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores

@@ -83,11 +83,26 @@ _COMPILE_EVENTS = (
 def _on_jax_event(name: str, dur: float, **_kw) -> None:
     if name not in _COMPILE_EVENTS:
         return
+    # per-thread compile sequence: jit compiles run on the calling
+    # thread, so this is the exact "did MY call compile?" signal the
+    # ISSUE-8 cost registry confirms cache-size deltas against (a
+    # cache grown by ANOTHER thread's concurrent compile must not be
+    # attributed to this thread's class label)
+    _tls.compile_seq = getattr(_tls, "compile_seq", 0) + 1
     ctx = getattr(_tls, "ctx", None)
     if ctx is None:
         return
     tele, shape = ctx
     tele._note_compile_event(shape, dur, is_trace=(name == _TRACE_EVENT))
+
+
+def thread_compile_seq() -> "int | None":
+    """Monotonic count of jax compile events observed on THIS thread,
+    or None while no listener is installed (no confirmation signal
+    available — callers fall back to cache-size-delta-only)."""
+    if not _listener_installed:
+        return None
+    return getattr(_tls, "compile_seq", 0)
 
 
 def _install_listener() -> bool:
@@ -135,6 +150,12 @@ class PipelineTelemetry:
         # the `trace` section — ring state + overlap/bubble analysis —
         # from it. None restores the pre-ISSUE-7 schema exactly.
         self.recorder = None
+        # the HBM ledger (ISSUE 8; set by the node when
+        # broker.hbm_ledger / EMQX_TPU_HBM_LEDGER is on): snapshot()
+        # derives the `memory` section — per-category device bytes,
+        # pin ages, backend memory_stats cross-check — from it. None
+        # restores the pre-ISSUE-8 schema exactly.
+        self.ledger = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -443,6 +464,16 @@ class PipelineTelemetry:
                 trace = self.recorder.snapshot_section()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # HBM ledger (ISSUE 8): per-category device bytes + peak
+        # watermarks + pin ages + the backend memory_stats cross-check
+        # — the section that makes "does it fit?" answerable before
+        # ROADMAP items 1/3 size anything
+        memory = {}
+        if self.ledger is not None:
+            try:
+                memory = self.ledger.section()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -464,9 +495,19 @@ class PipelineTelemetry:
             out["readback"] = readback
         if trace or full:
             out["trace"] = trace
+        if memory or full:
+            out["memory"] = memory
         jc = _jit_cache_sizes()
         if jc:
             out["jit_cache"] = jc
+        # jit-program cost registry (ISSUE 8): per-(program, class)
+        # compile wall-time — and flops/bytes once an off-path consumer
+        # (tools/profile_step.py --cost-out) has analyzed them — keyed
+        # by the same labels as compiles.by_shape. Snapshot never
+        # triggers the (re-lowering) analysis itself.
+        pc = _program_costs()
+        if pc is not None and (pc or full):
+            out["program_costs"] = pc
         return out
 
 
@@ -481,5 +522,24 @@ def _jit_cache_sizes() -> dict:
         return {}
     try:
         return mod.compile_stats()
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return {}
+
+
+def _program_costs() -> "dict | None":
+    """The ISSUE-8 jit-program cost registry (compile wall per class;
+    flops/bytes where analyzed) — same import discipline as
+    _jit_cache_sizes: snapshot() never forces a jax import and never
+    pays the lazy cost analysis (analyze=False). None when the
+    observatory knob is off (EMQX_TPU_HBM_LEDGER=0): the section must
+    not exist at all, exactly pre-ISSUE-8."""
+    import sys
+    mod = sys.modules.get("emqx_tpu.models.router_engine")
+    if mod is None:
+        return {}
+    try:
+        if not mod.cost_registry_enabled():
+            return None
+        return mod.cost_stats()
     except Exception:  # noqa: BLE001 — telemetry must never raise
         return {}

@@ -87,6 +87,19 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
                 "ring": rec.state()}
     route("GET", "/pipeline/trace", pipeline_trace)
 
+    # ---- device-resource observatory (ISSUE 8): the HBM ledger's
+    #      `memory` section standalone — per-category live bytes /
+    #      peak watermarks / pin ages + the backend memory_stats
+    #      cross-check (the same document telemetry snapshot embeds) ----
+    async def pipeline_memory(_req):
+        ledger = getattr(node, "hbm_ledger", None)
+        if ledger is None:
+            raise ApiError(404, "SERVICE_UNAVAILABLE",
+                           "HBM ledger not enabled "
+                           "(EMQX_TPU_HBM_LEDGER=0?)")
+        return ledger.section()
+    route("GET", "/pipeline/memory", pipeline_memory)
+
     # ---- clients ----
     async def clients(req):
         items = await mgmt.list_clients()

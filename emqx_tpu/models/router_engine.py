@@ -17,6 +17,8 @@ is threaded functionally through each step.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import NamedTuple
 
 import jax
@@ -680,7 +682,9 @@ def compile_stats() -> dict[str, int]:
     compiled (shape, dtype, static-args) variant, so a growing number
     under steady traffic means the serving path is re-tracing — the
     recompile signal pipeline telemetry surfaces via
-    `GET /api/v5/pipeline/stats` and the bench telemetry snapshot."""
+    `GET /api/v5/pipeline/stats` and the bench telemetry snapshot.
+    The per-class flop/byte/compile-time decomposition of the same
+    programs lives in `cost_stats()` (the ISSUE-8 cost registry)."""
     out = {}
     for fn in (route_step, route_step_shapes, route_window_shapes,
                route_window_full, route_step_cached, route_window_cached,
@@ -696,6 +700,248 @@ def compile_stats() -> dict[str, int]:
         except Exception:  # noqa: BLE001 — cache introspection is best-effort
             pass
     return out
+
+
+# ---- jit-program cost registry (ISSUE 8) --------------------------------
+# Every fused route program records, per compiled (W, B[, Bm][, dC][, P])
+# class, its compile wall-time and — on demand — the lowered program's
+# cost_analysis() (flops, bytes accessed). This is the per-program cost
+# table the ROADMAP-item-2 stage-graph builder needs as its oracle, and
+# the compiled-program leg of the ISSUE-8 device-resource observatory
+# (the HBM ledger meters data; this meters programs).
+#
+# Mechanics: each public program is wrapped so a call that GREW the
+# jit cache (a fresh compile) registers one row keyed by the active
+# telemetry compile-context label (the same "warm W8xB1024" /
+# "dispatch W1xB256" key space as snapshot()["compiles"]["by_shape"]),
+# with the args saved as ShapeDtypeStructs — no device data retained.
+# The flop/byte analysis itself is LAZY: `cost_stats(analyze=True)`
+# re-lowers from the saved avals (tracing only, no backend compile, no
+# jit-cache growth) the first time each row is queried, so the serving
+# path never pays for it; re-traces run outside any compile_context,
+# so telemetry's recompile counters are not inflated. Calls made while
+# tracing (a program fused inside another) bypass the bookkeeping
+# entirely — the outer program owns the compile.
+
+_COSTS: dict[str, dict[str, dict]] = {}
+_costs_lock = threading.Lock()
+_cost_programs: dict[str, object] = {}
+
+# The registry rides the observatory knob: EMQX_TPU_HBM_LEDGER=0 must
+# restore pre-ISSUE-8 behavior EXACTLY, and the route programs are
+# bound at import time, so this leg resolves the env half of the knob
+# once here (the per-node `broker.hbm_ledger` config gates the per-node
+# ledger; this registry is process-wide like the programs themselves).
+# Off means: programs stay unwrapped, zero per-call introspection, no
+# `program_costs` section in snapshots.
+from emqx_tpu.broker.hbm_ledger import resolve_hbm_ledger as _resolve_hbm
+
+COST_REGISTRY_ON = _resolve_hbm(None)
+
+
+def cost_registry_enabled() -> bool:
+    """Whether the route programs are wrapped with compile detection —
+    telemetry gates the `program_costs` snapshot section on this."""
+    return COST_REGISTRY_ON
+
+
+def _thread_compile_seq():
+    """Telemetry's per-thread compile-event counter (None when no
+    jax.monitoring listener is installed — no confirmation signal)."""
+    try:
+        from emqx_tpu.broker import telemetry as _T
+        return _T.thread_compile_seq()
+    except Exception:  # noqa: BLE001 — confirmation is best-effort
+        return None
+
+
+def _active_cost_label() -> "str | None":
+    """The thread's telemetry compile-context label, if any — keeps the
+    registry keyed the same way as the recompile counters."""
+    try:
+        from emqx_tpu.broker import telemetry as _T
+        ctx = getattr(_T._tls, "ctx", None)
+        if ctx is not None:
+            return ctx[1]
+    except Exception:  # noqa: BLE001 — labeling is best-effort
+        pass
+    return None
+
+
+def _avals_of(args, kwargs):
+    """(args, kwargs) with array leaves replaced by ShapeDtypeStructs
+    (statics pass through) — enough to re-lower, nothing pinned."""
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+    return jax.tree.map(one, (args, dict(kwargs)))
+
+
+def record_program_cost(program: str, label: str, *,
+                        compile_ms: float = 0.0, flops=None,
+                        bytes_accessed=None, avals=None) -> None:
+    """Register/extend one (program, class) cost row. The wrapped route
+    programs call this on compile detection; external harnesses
+    (tools/profile_step.py) use it to put their own kernels in the same
+    table."""
+    with _costs_lock:
+        row = _COSTS.setdefault(program, {}).setdefault(
+            label, {"compiles": 0, "compile_ms": 0.0})
+        row["compiles"] += 1
+        row["compile_ms"] = round(row["compile_ms"] + compile_ms, 3)
+        if flops is not None:
+            row["flops"] = flops
+        if bytes_accessed is not None:
+            row["bytes_accessed"] = bytes_accessed
+        if avals is not None:
+            row["_avals"] = avals
+
+
+def _analyze_lowered(lowered) -> tuple:
+    """(flops, bytes_accessed) out of a Lowered's cost_analysis(), or
+    (None, None) where the backend provides none."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:  # noqa: BLE001 — analysis availability varies
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+    flops = ca.get("flops")
+    ba = ca.get("bytes accessed")
+    return (float(flops) if flops is not None else None,
+            float(ba) if ba is not None else None)
+
+
+def cost_stats(analyze: bool = False) -> dict:
+    """The per-program cost table: {program: {class_label: {compiles,
+    compile_ms[, flops, bytes_accessed]}}}. `analyze=True` fills any
+    missing flop/byte rows by re-lowering from the saved avals —
+    tracing cost only, meant for off-path consumers (profile_step
+    --cost-out, tools) — and drops the avals afterwards. The default
+    is cheap and is what snapshot()["program_costs"] embeds."""
+    if analyze:
+        with _costs_lock:
+            todo = [(prog, label, row["_avals"])
+                    for prog, rows in _COSTS.items()
+                    for label, row in rows.items()
+                    if "_avals" in row and "flops" not in row]
+        for prog, label, avals in todo:
+            fn = _cost_programs.get(prog)
+            if fn is None:
+                continue
+            a, kw = avals
+            try:
+                flops, ba = _analyze_lowered(fn.lower(*a, **kw))
+            except Exception:  # noqa: BLE001 — a stale aval set (deleted
+                continue       # program variant) must not break the table
+            with _costs_lock:
+                row = _COSTS.get(prog, {}).get(label)
+                if row is not None:
+                    if flops is not None:
+                        row["flops"] = flops
+                    if ba is not None:
+                        row["bytes_accessed"] = ba
+                    row.pop("_avals", None)
+    with _costs_lock:
+        return {prog: {label: {k: v for k, v in row.items()
+                               if not k.startswith("_")}
+                       for label, row in rows.items()}
+                for prog, rows in _COSTS.items()}
+
+
+def reset_cost_stats() -> None:
+    """Drop every registered row (test isolation)."""
+    with _costs_lock:
+        _COSTS.clear()
+
+
+def _with_cost_registry(fn):
+    """Wrap one jitted program with compile detection (see the registry
+    comment above). Transparent to every existing caller: __name__,
+    _cache_size and lower() delegate to the wrapped jit function.
+    Identity when the observatory knob is off (EMQX_TPU_HBM_LEDGER=0):
+    the program flows through unwrapped, exactly pre-ISSUE-8."""
+    if not COST_REGISTRY_ON:
+        return fn
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        before = -1
+        try:
+            # only the introspection sits in the try — fn itself runs
+            # outside it, so a raising program is never mistaken for
+            # an introspection gap and re-invoked
+            if jax.core.trace_state_clean():
+                before = fn._cache_size()
+        except Exception:  # noqa: BLE001 — introspection gap: passthrough
+            before = -1
+        if before < 0:
+            # fused inside another program's trace (the outer program
+            # owns this compile), or introspection unavailable
+            return fn(*args, **kwargs)
+        seq0 = _thread_compile_seq()
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        try:
+            if fn._cache_size() > before \
+                    and not (seq0 is not None
+                             and _thread_compile_seq() == seq0):
+                # the seq check: jit compiles run on the calling
+                # thread, so a cache grown with NO compile event on
+                # this thread was another thread's concurrent compile
+                # of this program — its row, not ours to record under
+                # this class label
+                label = _active_cost_label()
+                if label is None:
+                    shapes = [tuple(x.shape) for x in
+                              jax.tree.leaves(args)
+                              if hasattr(x, "shape") and
+                              getattr(x, "ndim", 0) >= 2][:1]
+                    label = f"adhoc {shapes[0] if shapes else '()'}"
+                record_program_cost(
+                    name, label,
+                    compile_ms=(time.perf_counter() - t0) * 1000.0,
+                    avals=_avals_of(args, kwargs))
+        except Exception:  # noqa: BLE001 — cost accounting is best-effort
+            pass
+        return out
+
+    wrapped._fun = fn
+    wrapped._cache_size = fn._cache_size
+    wrapped.lower = fn.lower
+    _cost_programs[name] = fn
+    return wrapped
+
+
+# rebind the public programs through the registry wrapper — callers
+# (device_engine, serving, benches, tests) see the same names with
+# identical call/introspection surfaces
+route_step = _with_cost_registry(route_step)
+route_step_shapes = _with_cost_registry(route_step_shapes)
+route_window_shapes = _with_cost_registry(route_window_shapes)
+route_window_full = _with_cost_registry(route_window_full)
+route_step_cached = _with_cost_registry(route_step_cached)
+route_window_cached = _with_cost_registry(route_window_cached)
+route_step_compact = _with_cost_registry(route_step_compact)
+route_step_cached_compact = _with_cost_registry(route_step_cached_compact)
+route_window_full_compact = _with_cost_registry(route_window_full_compact)
+route_window_cached_compact = \
+    _with_cost_registry(route_window_cached_compact)
+route_step_delta = _with_cost_registry(route_step_delta)
+route_window_delta = _with_cost_registry(route_window_delta)
+route_step_delta_cached = _with_cost_registry(route_step_delta_cached)
+route_window_delta_cached = _with_cost_registry(route_window_delta_cached)
+route_step_delta_compact = _with_cost_registry(route_step_delta_compact)
+route_window_delta_compact = \
+    _with_cost_registry(route_window_delta_compact)
+route_step_delta_cached_compact = \
+    _with_cost_registry(route_step_delta_cached_compact)
+route_window_delta_cached_compact = \
+    _with_cost_registry(route_window_delta_cached_compact)
 
 
 def empty_router_tables(filter_cap: int = 16) -> RouterTables:

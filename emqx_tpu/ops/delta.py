@@ -177,7 +177,9 @@ def delta_overlay(dt: DeltaTables, topics: jax.Array, lens: jax.Array,
 def build_delta_tables(entries: list, *, row_cap: int, level_cap: int,
                        fan_per_row: int = 8) -> DeltaTables:
     """Compile overlay entries into DeltaTables (numpy; device_put by
-    the caller).
+    the caller — `broker/device_engine._refresh_overlay` places it and
+    registers the placed tree under the HBM ledger's `delta_overlay`
+    category, one owner per overlay version, ISSUE 8).
 
     entries: list of (word_ids, fid, fan) where `fan` is a list of
     (session_row, packed_opts) — pass an EMPTY fan list for rows whose

@@ -115,7 +115,7 @@ class ShardedRouteServer:
                  level_cap: int = 16, max_batch: int = 256,
                  compact_readback: Optional[bool] = None,
                  delta_overlay: Optional[bool] = None,
-                 supervisor=None):
+                 supervisor=None, ledger=None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -207,6 +207,12 @@ class ShardedRouteServer:
             else getattr(node, "supervisor", None)
         if self.sup is not None:
             self.sup.register_probe("mesh_exchange", self._probe_mesh)
+
+        # HBM ledger (ISSUE 8): the stacked mesh shard tables + cursors
+        # register under mesh_tables / mesh_cursors; dispatch handles
+        # ride the pin sentinel like the single-chip engine's
+        self.ledger = ledger if ledger is not None \
+            else getattr(node, "hbm_ledger", None)
 
         # engine wiring (same hooks DeviceRouteEngine claims)
         self.broker.device_engine = self
@@ -389,8 +395,15 @@ class ShardedRouteServer:
             cursors.append(cur)
         stacked = stack_tables(tables)
         dev_tables, dev_cursors = put_sharded(
-            self.mesh, stacked, np.stack(cursors))
+            self.mesh, stacked, np.stack(cursors), ledger=self.ledger)
         return caps, builts, dev_tables, dev_cursors
+
+    def _hold(self, category: str, tree, owner=None):
+        """Register a persistent device allocation with the HBM ledger
+        (ISSUE 8); identity passthrough when the ledger is off."""
+        if self.ledger is not None:
+            return self.ledger.hold(category, tree, owner=owner)
+        return tree
 
     def _adopt_full_build(self, result, gen: int) -> bool:
         caps, builts, dev_tables, dev_cursors = result
@@ -528,15 +541,19 @@ class ShardedRouteServer:
                 return False
             b, t, cur = self._build_shard(capture, self._caps)
             with self._lock:
-                self.tables = update_shard(self.tables, s, t,
-                                           donate=False)
+                # update_shard emits all-new stacked arrays (donate=
+                # False): re-register them so the ledger tracks the
+                # live generation (the superseded arrays release on GC)
+                self.tables = self._hold(
+                    "mesh_tables", update_shard(self.tables, s, t,
+                                                donate=False))
                 cur_np = np.array(self.cursors)     # copy: jax buffers
                 cur_np[s] = cur                     # are read-only
                 import jax
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
-                self.cursors = jax.device_put(
-                    cur_np, NamedSharding(self.mesh, P("route")))
+                self.cursors = self._hold("mesh_cursors", jax.device_put(
+                    cur_np, NamedSharding(self.mesh, P("route"))))
                 # copy-on-write: in-flight handles keep decoding with the
                 # list they captured (their tables snapshot predates this
                 # update), and the dispatch-side `_builts is h.built`
@@ -650,6 +667,8 @@ class ShardedRouteServer:
     def abandon(self, h: _Handle) -> None:
         h.res = None
         h.np_res = None
+        if self.ledger is not None:
+            self.ledger.unpin(id(h))
 
     def prepare(self, msgs: list[Message]) -> Optional[_Handle]:
         return self.prepare_window([msgs])
@@ -697,10 +716,17 @@ class ShardedRouteServer:
         if tele is not None:
             tele.record_occupancy(f"b{Bp}", len(msgs) / Bp)
         with self._lock:
-            return _Handle(subs=[msgs], built=self._builts,
-                           tables=self.tables, cursors=self.cursors,
-                           enc=(enc, lens, dollar, msg_hash),
-                           host_idx=host_idx)
+            h = _Handle(subs=[msgs], built=self._builts,
+                        tables=self.tables, cursors=self.cursors,
+                        enc=(enc, lens, dollar, msg_hash),
+                        host_idx=host_idx)
+        if self.ledger is not None:
+            # pin sentinel (ISSUE 8): mesh handles pin the whole
+            # stacked snapshot by reference — a leaked one holds every
+            # shard's HBM, so it rides the same stale-pin clock
+            self.ledger.note_window()
+            self.ledger.pin(id(h), h)
+        return h
 
     def dispatch(self, h: _Handle) -> None:
         """Stage 2 (executor thread): run the mesh step on the handle's
@@ -741,7 +767,8 @@ class ShardedRouteServer:
             self.sup.note_ok("mesh_exchange")
         with self._lock:
             if self._builts is h.built:    # no rebuild raced us
-                self.cursors = h.res.new_cursors
+                self.cursors = self._hold("mesh_cursors",
+                                          h.res.new_cursors)
         if tele is not None:
             tele.observe_stage("dispatch", time.perf_counter() - t0)
         self._rec_span(h.trace, "dispatch", t0, track="dispatch")
@@ -916,6 +943,10 @@ class ShardedRouteServer:
         if tele is not None:
             tele.observe_stage("deliver", time.perf_counter() - t0)
         self._rec_span(h.trace, "deliver", t0, track="consume")
+        if self.ledger is not None:
+            # consumed (lane plans keep the arrays alive by reference;
+            # the pin tracks swap-blocking in-flight handles only)
+            self.ledger.unpin(id(h))
         return counts
 
     def _collect_clean(self, msg, i: int, np_res, builts):

@@ -40,11 +40,20 @@ def stack_tables(tables_list: list) -> RouterTables:
     return jax.tree.map(lambda *xs: np.stack(xs), *tables_list)
 
 
-def put_sharded(mesh: Mesh, tables_stacked: RouterTables, cursors_stacked):
-    """Place stacked tables/cursors with their 'route' sharding."""
+def put_sharded(mesh: Mesh, tables_stacked: RouterTables, cursors_stacked,
+                ledger=None):
+    """Place stacked tables/cursors with their 'route' sharding.
+
+    `ledger` (broker.hbm_ledger.HbmLedger, ISSUE 8): when given, the
+    placed pytrees register as the mesh_tables / mesh_cursors
+    categories so the shard tables stop being unaccounted HBM."""
     spec = NamedSharding(mesh, P("route"))
+    # hbm: held right below under mesh_tables / mesh_cursors
     tables = jax.tree.map(lambda x: jax.device_put(x, spec), tables_stacked)
-    cursors = jax.device_put(cursors_stacked, spec)
+    cursors = jax.device_put(cursors_stacked, spec)  # hbm: held below
+    if ledger is not None:
+        tables = ledger.hold("mesh_tables", tables)
+        cursors = ledger.hold("mesh_cursors", cursors)
     return tables, cursors
 
 

@@ -9,12 +9,23 @@ numbers decompose the real batch cost instead of guessing.
 
 Usage: python tools/profile_step.py [subs] [batch] [window]
                                     [--telemetry-out FILE]
+                                    [--cost-out FILE]
 
 --telemetry-out dumps the run as a pipeline-telemetry snapshot
 (broker.telemetry SCHEMA — the same JSON shape bench.py embeds and
 GET /api/v5/pipeline/stats serves): each profiled kernel becomes a stage
 row (per-batch ms) and its warm/compile cost lands in the compile
 accounting, so profiling rounds and bench rounds share one schema.
+
+--cost-out (ISSUE 8 satellite) dumps the jit-program cost-registry
+table: every profiled kernel registers its compile wall-time AND its
+lowered `cost_analysis()` (flops, bytes accessed) under the same
+`program_costs` section schema `snapshot()["program_costs"]` embeds —
+`{program: {class_label: {compiles, compile_ms, flops,
+bytes_accessed}}}` — so the ROADMAP-item-2 stage-graph builder reads
+one oracle whether the numbers came from a profiling round or a
+serving run (`cost_stats(analyze=True)` fills any route-program rows
+recorded during this run too).
 
 The FULL schema (ISSUE 7 satellite): the snapshot carries every
 section bench rounds now emit, not just the PR-1 stages/occupancy/
@@ -43,8 +54,10 @@ def log(*a):
 
 
 def _parse_args(argv):
-    """Positional [subs] [batch] [window] + --telemetry-out FILE."""
+    """Positional [subs] [batch] [window] + --telemetry-out FILE
+    + --cost-out FILE."""
     out = None
+    cost_out = None
     pos = []
     it = iter(argv)
     for a in it:
@@ -52,9 +65,13 @@ def _parse_args(argv):
             out = next(it, None)
         elif a.startswith("--telemetry-out="):
             out = a.split("=", 1)[1]
+        elif a == "--cost-out":
+            cost_out = next(it, None)
+        elif a.startswith("--cost-out="):
+            cost_out = a.split("=", 1)[1]
         else:
             pos.append(a)
-    return pos, out
+    return pos, out, cost_out
 
 
 def _slug(name: str) -> str:
@@ -62,7 +79,7 @@ def _slug(name: str) -> str:
 
 
 def main():
-    pos, telemetry_out = _parse_args(sys.argv[1:])
+    pos, telemetry_out, cost_out = _parse_args(sys.argv[1:])
     subs = int(pos[0]) if len(pos) > 0 else 1_000_000
     B = int(pos[1]) if len(pos) > 1 else 131072
     window = int(pos[2]) if len(pos) > 2 else 16
@@ -160,6 +177,32 @@ def main():
     FAN_CAP = int(os.environ.get("BENCH_FANOUT_CAP", 4))
     SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 2))
 
+    from emqx_tpu.models.router_engine import (_analyze_lowered,
+                                               record_program_cost)
+
+    def _record_cost(stage, fn, warm_ms):
+        """One cost-registry row per profiled kernel (ISSUE 8
+        satellite): the warm pass's compile wall-time plus the lowered
+        program's cost_analysis (flops, bytes accessed) — the same
+        `program_costs` table the serving path's route programs
+        populate, so --cost-out and the telemetry snapshot share one
+        schema. Lowering is tracing-only (no backend compile); kernels
+        without .lower (the fused-window wrapper) record wall only.
+        The re-lower (a full re-trace per kernel) runs only when a
+        consumer asked for the table — a bare profiling run stays at
+        wall-time-only rows."""
+        flops = ba = None
+        if cost_out or telemetry_out:
+            try:
+                low = fn.lower(_put_retry(np.int32(0)), tables,
+                               staged[0])
+                flops, ba = _analyze_lowered(low)
+            except Exception:  # noqa: BLE001 — analysis is best-effort
+                pass
+        record_program_cost(stage, f"profile {stage}",
+                            compile_ms=warm_ms, flops=flops,
+                            bytes_accessed=ba)
+
     def timed(name, fn, topics_per_call=B):
         """Pipelined window of `fn(acc, tables, staged[i])` closed by one
         scalar read. Tables ride as explicit jit arguments — closing over
@@ -177,8 +220,11 @@ def main():
                 acc = fn(acc, tables, staged[i % 8])
             _ = int(np.asarray(acc))
             return time.time() - t0
+        t_warm = time.perf_counter()
         with tele.compile_context(f"profile {stage}"):
             run(2)  # warm/compile (attributed to this kernel's shape)
+        _record_cost(stage, fn,
+                     (time.perf_counter() - t_warm) * 1000.0)
         t_meas = time.perf_counter()
         dt = run(window)
         # each timed kernel is one "window" on the flight recorder:
@@ -323,6 +369,22 @@ def main():
         with open(telemetry_out, "w") as f:
             json.dump(snap, f, indent=1)
         log(f"telemetry snapshot -> {telemetry_out}")
+
+    if cost_out:
+        # the per-program cost table (ISSUE 8): analyze=True fills
+        # flops/bytes for any route-program rows this run compiled
+        # (tracing cost only — exactly the off-path consumer the lazy
+        # analysis exists for); the profiled kernels' rows were
+        # recorded eagerly above
+        from emqx_tpu.broker.telemetry import SCHEMA as PIPE_SCHEMA
+        from emqx_tpu.models.router_engine import cost_stats
+        doc = {"schema": PIPE_SCHEMA,
+               "program_costs": cost_stats(analyze=True),
+               "profile": {"subs": subs, "batch": B, "window": window,
+                           "fuse": FUSE}}
+        with open(cost_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        log(f"program cost table -> {cost_out}")
 
 
 if __name__ == "__main__":
