@@ -1422,11 +1422,19 @@ def run_e2e(n_filters: int, n_sub_conns: int, n_pub_conns: int,
             # the top bubble attributions without digging — the e2e
             # gap diagnosis even if the round dies right after
             tr = snap.get("trace") or {}
-            if tr.get("overlap") or tr.get("bubbles"):
+            if use_device or tr.get("overlap") or tr.get("bubbles"):
+                # ISSUE 9: the overlap row now ALWAYS rides device e2e
+                # phases (checkpointed with them), carrying the
+                # dispatch depth next to the fraction — the acceptance
+                # metric survives even if later phases die, and a
+                # depth-1 A/B run is distinguishable in the artifact
                 out_extra["overlap"] = {
                     "dispatch_materialize":
                         (tr.get("overlap") or {}).get(
                             "dispatch_materialize"),
+                    "dispatch_depth":
+                        node.publish_batcher.dispatch_depth
+                        if node.publish_batcher is not None else None,
                     "windows": tr.get("windows"),
                     "bubbles_top":
                         (tr.get("bubbles") or {}).get("top"),
@@ -1573,6 +1581,21 @@ def main():
     deadline = time.time() + init_budget
     axon = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) and \
         "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower()
+
+    if axon and os.environ.get("WATCHER_REARM", "1") != "0":
+        # watcher re-arm guard (ISSUE 9 satellite): a dead watcher pid
+        # means the round has no mid-round window coverage — respawn it
+        # before this bench claims the pool (the watcher's .hold/.pid
+        # protocol keeps the two from racing a window). Never runs on
+        # CPU/CI boxes (no axon pool configured).
+        try:
+            subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "relay_watcher.py"), "--rearm"],
+                capture_output=True, timeout=30)
+        except Exception as e:  # noqa: BLE001 — guard is best-effort
+            log(f"watcher rearm failed: {type(e).__name__}: {e}")
 
     def relay_listening() -> bool:
         try:

@@ -29,6 +29,25 @@ Round-2 rework (VERDICT weak #2/#3/#4):
   Pipelined device cost is sampled as completion-to-completion time (the
   amortized rate the pipeline actually delivers), not the full round-trip.
 
+Round-10 rework (ISSUE 9 tentpole) — the **double-buffered window
+pipeline**: at ``dispatch_depth >= 2`` the consumer becomes a bounded
+in-flight settle ring. Each dispatched window's remaining stages
+(await dispatch → launch + await materialize) run in their OWN task the
+moment the window is admitted from the FIFO queue, up to
+``dispatch_depth`` windows concurrently — so dispatch(W+1) runs while
+materialize(W) is still crossing the link, and with the engine's async
+readback (start-transfer at dispatch return) materialize is
+consume-on-arrival. Settle order stays STRICTLY FIFO (the ring head is
+always completed first), so per-publisher ordering and the journal
+discipline are bit-identical to the synchronous loop. Knob:
+``broker.dispatch_depth`` / ``EMQX_TPU_DISPATCH_DEPTH`` (config beats
+env beats default 2); ``=1`` restores the pre-ISSUE-9 synchronous
+consumer EXACTLY — same code path, same jit programs (no cursor
+donation), the A/B baseline. Supervision: each in-flight window's
+stage awaits are bounded by the watchdog deadlines INDEPENDENTLY (one
+stage task per window), and a mid-pipeline death replays exactly the
+journaled windows it touched through the host rung.
+
 Ordering: submissions are FIFO; batches complete in arrival order; within a
 batch messages are consumed in order — MQTT's per-publisher-per-topic
 ordering is preserved end to end.
@@ -37,6 +56,7 @@ ordering is preserved end to end.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -50,12 +70,36 @@ from emqx_tpu.broker.message import Message
 _PROBE_EVERY = 64
 
 
+def resolve_dispatch_depth(configured=None) -> int:
+    """The one dispatch-depth resolution (ISSUE 9): config
+    (``broker.dispatch_depth``) beats ``EMQX_TPU_DISPATCH_DEPTH`` beats
+    the built-in 2. ``=1`` restores the synchronous consumer loop (and
+    the non-donating jit programs) exactly — the A/B baseline every
+    depth-twin test compares. Must be a positive integer; anything else
+    is a deployment error worth failing loudly on."""
+    if configured is None:
+        env = os.environ.get("EMQX_TPU_DISPATCH_DEPTH")
+        if env is None:
+            return 2
+        configured = env
+    try:
+        val = int(configured)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"EMQX_TPU_DISPATCH_DEPTH={configured!r} is not an integer")
+    if val < 1:
+        raise ValueError(
+            f"EMQX_TPU_DISPATCH_DEPTH must be >= 1, got {val}")
+    return val
+
+
 class PublishBatcher:
     def __init__(self, node, engine, *, window_us: int = 200,
                  max_batch: int = 1024, device_min_batch: int = 4,
                  max_pending: Optional[int] = None,
                  pipeline_depth: int = 8, host_probe_every: int = 32,
-                 window_fuse: int = 8):
+                 window_fuse: int = 8,
+                 dispatch_depth: Optional[int] = None):
         self.node = node
         self.engine = engine
         # pipeline telemetry (stage spans / occupancy / decisions) — a
@@ -75,6 +119,11 @@ class PublishBatcher:
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
         self.pipeline_depth = pipeline_depth
+        # ISSUE 9: how many dispatched windows may run their remaining
+        # stages (dispatch-await + materialize) concurrently ahead of
+        # their FIFO settle turn. 1 = the pre-ISSUE-9 synchronous
+        # consumer, bit-exact (the legacy code path below).
+        self.dispatch_depth = resolve_dispatch_depth(dispatch_depth)
         self.host_probe_every = host_probe_every
         # under sustained load, up to this many consecutive batches fuse
         # into ONE device dispatch (route_window_full) — the per-dispatch
@@ -613,12 +662,16 @@ class PublishBatcher:
                 self.sup.journal_settle(entry.get("wid"))
 
     async def _consume(self) -> None:
+        if self.dispatch_depth > 1:
+            # ISSUE 9 tentpole: the bounded in-flight settle ring —
+            # stages run ahead per window, settle stays FIFO
+            await self._consume_pipelined()
+            return
         loop = asyncio.get_running_loop()
         while True:
             entry = await self._inflight.get()
             if entry.get("eof"):
-                if self._inflight.empty() and not self._queue \
-                        and (self._task is None or self._task.done()):
+                if self._park_ok():
                     return
                 continue
             self._consuming = True
@@ -637,6 +690,153 @@ class PublishBatcher:
                 self._fail_entry(entry, e)
             finally:
                 self._consuming = False
+
+    def _park_ok(self) -> bool:
+        """True when the consumer may park (queue drained, producer
+        done) — the legacy loop's eof exit condition, shared by the
+        pipelined ring."""
+        return self._inflight.empty() and not self._queue \
+            and (self._task is None or self._task.done())
+
+    async def _run_stages(self, entry: dict, loop) -> bool:
+        """The in-flight stage task of ONE dispatched window (ISSUE 9):
+        await its dispatch, then launch + await its materialize — ahead
+        of the window's FIFO settle turn, concurrently with up to
+        dispatch_depth-1 other windows' stage tasks. Returns False
+        (handle abandoned, fault noted, replay counted) when the window
+        must fall back to the host rung at settle; the error handling is
+        the depth-1 consumer's, verbatim, so the supervision contract —
+        per-window watchdog deadlines, breaker advancement, journal
+        replay — is identical per in-flight window."""
+        handle = entry["handle"]
+        handle.t0 = time.perf_counter()
+        try:
+            if self.sup is None:
+                try:
+                    await entry["dispatch_fut"]
+                    await loop.run_in_executor(
+                        self._read_pool, self.engine.materialize, handle)
+                except Exception as e:
+                    self.engine.abandon(handle)
+                    self.node.metrics.inc(
+                        "routing.device.dispatch_failed")
+                    self._note_replay_span(entry, "device",
+                                           type(e).__name__)
+                    return False
+                return True
+            if not await self._await_stage(entry["dispatch_fut"],
+                                           "dispatch", handle, entry):
+                return False
+            mat = loop.run_in_executor(
+                self._read_pool, self.engine.materialize, handle)
+            return await self._await_stage(mat, "materialize", handle,
+                                           entry)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # stage machinery itself failed
+            self.engine.abandon(handle)
+            self.node.metrics.inc("routing.device.dispatch_failed")
+            self._note_replay_span(entry, "device", type(e).__name__)
+            return False
+
+    async def _consume_pipelined(self) -> None:
+        """Depth-N in-flight settle ring (ISSUE 9 tentpole).
+
+        Admission: entries pop from the FIFO queue into the ring; a
+        DISPATCHING entry (it owns a window's dispatch_fut) starts its
+        stage task immediately, and admission pauses once
+        ``dispatch_depth`` such windows are in flight (host batches and
+        fused-window followers admit freely — they pin no extra device
+        buffers). Settle: strictly the ring head, so completion order —
+        and therefore per-publisher delivery order, lane drains, and
+        journal settles — is bit-identical to the synchronous loop; only
+        WHEN dispatch/materialize run moves. A stage task that failed
+        (timeout / fault / injected chaos) already abandoned its handle
+        and noted the fault; its window (and independently any other
+        in-flight window the same death took down) replays through the
+        host rung at its own settle turn — zero QoS>=1 loss, FIFO
+        preserved."""
+        from emqx_tpu.broker.supervise import guard_task
+        loop = asyncio.get_running_loop()
+        ring: deque = deque()
+        eof_seen = False
+        try:
+            while True:
+                while not eof_seen:
+                    if ring:
+                        # count LIVE stage tasks only: a window whose
+                        # stages finished but which still waits its
+                        # FIFO settle turn no longer occupies a
+                        # pipeline slot — counting it would serialize
+                        # admission behind the settle loop and collapse
+                        # the effective depth to ~1 under load
+                        in_flight = sum(
+                            1 for e in ring
+                            if e.get("stage_task") is not None
+                            and not e["stage_task"].done())
+                        if in_flight >= self.dispatch_depth:
+                            break
+                        try:
+                            entry = self._inflight.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                    else:
+                        entry = await self._inflight.get()
+                    if entry.get("eof"):
+                        if ring:
+                            # drain the ring first, then re-check the
+                            # park condition (the producer already
+                            # exited after this eof)
+                            eof_seen = True
+                            break
+                        if self._park_ok():
+                            return
+                        continue
+                    if entry.get("handle") is not None \
+                            and entry.get("dispatch_fut") is not None \
+                            and "error" not in entry:
+                        entry["stage_task"] = guard_task(
+                            loop.create_task(
+                                self._run_stages(entry, loop)),
+                            "batcher-window-stages", self.node.metrics)
+                    ring.append(entry)
+                    # the trickle fast path must not overtake ring
+                    # entries: anything in the ring means "mid-consume"
+                    self._consuming = True
+                if not ring:
+                    continue
+                entry = ring.popleft()
+                # pipelined-cost sampling hint: more windows behind us
+                # means the completion-to-completion sample is the
+                # amortized rate (same rule as the depth-1 queue check)
+                entry["_pipeline_busy"] = bool(ring)
+                try:
+                    routed = None
+                    if entry.get("handle") is not None \
+                            and "error" not in entry:
+                        routed = await self._complete_device(entry, loop)
+                    await self._complete_host(entry, routed)
+                except asyncio.CancelledError:
+                    self._fail_entry(
+                        entry, RuntimeError("publish batcher stopped"))
+                    raise
+                except Exception as e:
+                    self._fail_entry(entry, e)
+                finally:
+                    self._consuming = bool(ring)
+                if eof_seen and not ring:
+                    eof_seen = False
+                    if self._park_ok():
+                        return
+        except asyncio.CancelledError:
+            err = RuntimeError("publish batcher stopped")
+            for e in ring:
+                st = e.get("stage_task")
+                if st is not None and not st.done():
+                    st.cancel()
+                self._fail_entry(e, err)
+            self._consuming = False
+            raise
 
     async def _complete_device(self, entry: dict, loop) -> Optional[list]:
         """Await dispatch + readback off-loop, consume on-loop. Returns the
@@ -657,7 +857,20 @@ class PublishBatcher:
         sub = entry.get("sub", 0)
         n_subs = len(handle.subs)
         sup = self.sup
-        if entry["dispatch_fut"] is not None:
+        st = entry.get("stage_task")
+        if st is not None:
+            # pipelined mode (ISSUE 9): the window's dispatch/
+            # materialize ran (watchdog-bounded) in its own in-flight
+            # stage task — settle just collects the verdict
+            try:
+                ok = await st
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # guard_task already logged it
+                ok = False
+            if not ok:
+                return None
+        elif entry["dispatch_fut"] is not None:
             handle.t0 = time.perf_counter()
             if sup is None:
                 try:
@@ -733,7 +946,8 @@ class PublishBatcher:
             # = completion-to-completion when the pipeline was busy; full
             # latency otherwise.
             if self._last_dev_done is not None \
-                    and not self._inflight.empty():
+                    and (not self._inflight.empty()
+                         or entry.get("_pipeline_busy")):
                 sample = (done - self._last_dev_done) / n_subs
             else:
                 sample = (done - (handle.t0 or done)) / n_subs

@@ -491,7 +491,8 @@ class DeviceRouteEngine:
                  dedup: Optional[bool] = None,
                  compact_readback: Optional[bool] = None,
                  delta_overlay: Optional[bool] = None,
-                 supervisor=None, ledger=None):
+                 supervisor=None, ledger=None,
+                 dispatch_depth: Optional[int] = None):
         self.node = node
         self.broker = node.broker
         self.router = node.broker.router
@@ -585,6 +586,19 @@ class DeviceRouteEngine:
             delta_overlay = _ENV_DELTA
         self.delta_overlay = bool(delta_overlay)
         self._overlay: Optional[_Overlay] = None  # current serving table
+
+        # double-buffered window pipeline (ISSUE 9 tentpole): at
+        # dispatch_depth >= 2 the serving dispatch (a) threads cursors
+        # through the DONATING program twins so the ping-pong buffers
+        # reuse HBM (models.router_engine.donating), and (b) starts the
+        # device→host transfers of every readback plane at dispatch
+        # return (copy_to_host_async-style), so materialize is
+        # consume-on-arrival under the next window's dispatch. Depth 1
+        # restores the pre-ISSUE-9 programs and synchronous readback
+        # exactly — the A/B baseline. Config beats env beats default 2.
+        from emqx_tpu.broker.batcher import resolve_dispatch_depth
+        self.dispatch_depth = resolve_dispatch_depth(dispatch_depth)
+        self._pipelined = self.dispatch_depth > 1
         self._overlay_stale = False     # journal entries pending apply
         self._overlay_clock = 0         # monotonic overlay mutation clock
         self._overlay_uncovered = 0     # live delta filters NOT in the
@@ -1302,18 +1316,24 @@ class DeviceRouteEngine:
             dollar = np.zeros((Wp, Bp), bool)
             mh = np.zeros((Wp, Bp), np.int32)
             with ctx:
+                # warm the program the serving path will actually
+                # dispatch (the donating twin at depth >= 2) with a
+                # throwaway cursors buffer — never the live one, which
+                # the twin would donate away (_warm_cursors)
                 if b.backend == "shapes":
-                    r = route_window_full(tables, cursors, enc, lens,
-                                          dollar, mh, strat,
-                                          fanout_cap=self.fanout_cap,
-                                          slot_cap=self.slot_cap)
+                    r = self._rt(route_window_full)(
+                        tables, self._warm_cursors(cursors), enc, lens,
+                        dollar, mh, strat,
+                        fanout_cap=self.fanout_cap,
+                        slot_cap=self.slot_cap)
                 else:
-                    r = route_step(tables, cursors, enc[0], lens[0],
-                                   dollar[0], mh[0], strat,
-                                   frontier_cap=self.frontier_cap,
-                                   match_cap=self.match_cap,
-                                   fanout_cap=self.fanout_cap,
-                                   slot_cap=self.slot_cap)
+                    r = self._rt(route_step)(
+                        tables, self._warm_cursors(cursors), enc[0],
+                        lens[0], dollar[0], mh[0], strat,
+                        frontier_cap=self.frontier_cap,
+                        match_cap=self.match_cap,
+                        fanout_cap=self.fanout_cap,
+                        slot_cap=self.slot_cap)
                 jax.block_until_ready(r.match_counts)
         if b.backend == "shapes":
             # this snapshot's classes are warm: once IT is serving, the
@@ -1373,13 +1393,20 @@ class DeviceRouteEngine:
         z = np.zeros((1, Bp), np.int32)
         zb = np.zeros((1, Bp), bool)
         strat = np.int32(STRATEGY_ROUND_ROBIN)
+        # ISSUE 9: at dispatch_depth >= 2 the serving dispatch DONATES
+        # the live cursors buffer, so the probe must not hand it to a
+        # concurrent call — it probes with a throwaway device buffer
+        # (the probe's cursor state is discarded anyway); the PLAIN
+        # program is kept deliberately (off-path; a cold compile here
+        # never stalls serving)
+        cur = self._warm_cursors(self._cursors)
         if self._built.backend == "shapes":
-            r = RE.route_window_full(self._tables, self._cursors, enc,
+            r = RE.route_window_full(self._tables, cur, enc,
                                      z, zb, z, strat,
                                      fanout_cap=self.fanout_cap,
                                      slot_cap=self.slot_cap)
         else:
-            r = RE.route_step(self._tables, self._cursors, enc[0], z[0],
+            r = RE.route_step(self._tables, cur, enc[0], z[0],
                               zb[0], z[0], strat,
                               frontier_cap=self.frontier_cap,
                               match_cap=self.match_cap,
@@ -1922,6 +1949,12 @@ class DeviceRouteEngine:
             from emqx_tpu.ops.delta import empty_delta_tables
             from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN
             strat = np.int32(STRATEGY_ROUND_ROBIN)
+            rt = self._rt
+
+            def wc():
+                # fresh throwaway cursors per program call: the
+                # donating twins consume their input (_warm_cursors)
+                return self._warm_cursors(cursors)
 
             def dummy_delta(dC):
                 # shapes are all that matter for the trace; an all-empty
@@ -1937,8 +1970,8 @@ class DeviceRouteEngine:
                 enc = np.zeros((Wp, Bp, self.max_levels), np.int32)
                 z = np.zeros((Wp, Bp), np.int32)
                 with ctx_of(f"warm W{Wp}xB{Bp}"):
-                    r = route_window_full(
-                        tables, cursors, enc, z, np.zeros((Wp, Bp), bool),
+                    r = rt(route_window_full)(
+                        tables, wc(), enc, z, np.zeros((Wp, Bp), bool),
                         z, strat, fanout_cap=self.fanout_cap,
                         slot_cap=self.slot_cap)
                     jax.block_until_ready(r.match_counts)
@@ -1953,15 +1986,15 @@ class DeviceRouteEngine:
                 zb = np.zeros((Wp, Bp), bool)
                 with ctx_of(f"warm W{Wp}xB{Bp}d{dC}"):
                     if backend == "shapes":
-                        r = route_window_delta(
-                            tables, dt, cursors, enc, z, zb, z, strat,
+                        r = rt(route_window_delta)(
+                            tables, dt, wc(), enc, z, zb, z, strat,
                             fanout_cap=self.fanout_cap,
                             slot_cap=self.slot_cap,
                             delta_match_cap=_DELTA_MATCH_CAP,
                             delta_fanout_cap=_DELTA_FANOUT_CAP)
                     else:   # trie delta dispatches are single-batch
-                        r = route_step_delta(
-                            tables, dt, cursors, enc[0], z[0], zb[0],
+                        r = rt(route_step_delta)(
+                            tables, dt, wc(), enc[0], z[0], zb[0],
                             z[0], strat, frontier_cap=self.frontier_cap,
                             match_cap=self.match_cap,
                             fanout_cap=self.fanout_cap,
@@ -1989,13 +2022,13 @@ class DeviceRouteEngine:
                         inv = np.zeros((Wp, Bp), np.int32)
                         mh = np.zeros((Wp, Bp), np.int32)
                         if dC is None:
-                            r = route_window_cached(
-                                tables, cursors, *args, *pos, inv, mh,
+                            r = rt(route_window_cached)(
+                                tables, wc(), *args, *pos, inv, mh,
                                 strat, fanout_cap=self.fanout_cap,
                                 slot_cap=self.slot_cap)
                         else:
-                            r = route_window_delta_cached(
-                                tables, dummy_delta(dC), cursors, *args,
+                            r = rt(route_window_delta_cached)(
+                                tables, dummy_delta(dC), wc(), *args,
                                 *dargs, *pos, inv, mh, strat,
                                 fanout_cap=self.fanout_cap,
                                 slot_cap=self.slot_cap,
@@ -2010,12 +2043,12 @@ class DeviceRouteEngine:
                                   fanout_cap=self.fanout_cap,
                                   slot_cap=self.slot_cap)
                         if dC is None:
-                            r = route_step_cached(
-                                tables, cursors, *args, *pos, inv, mh,
+                            r = rt(route_step_cached)(
+                                tables, wc(), *args, *pos, inv, mh,
                                 strat, **kw)
                         else:
-                            r = route_step_delta_cached(
-                                tables, dummy_delta(dC), cursors, *args,
+                            r = rt(route_step_delta_cached)(
+                                tables, dummy_delta(dC), wc(), *args,
                                 *dargs, *pos, inv, mh, strat, **kw,
                                 delta_match_cap=_DELTA_MATCH_CAP,
                                 delta_fanout_cap=_DELTA_FANOUT_CAP).res
@@ -2045,14 +2078,14 @@ class DeviceRouteEngine:
                         zb = np.zeros((Wp, Bp), bool)
                         if backend == "shapes":
                             if dC is None:
-                                r = route_window_full_compact(
-                                    tables, cursors, enc, z, zb, z,
+                                r = rt(route_window_full_compact)(
+                                    tables, wc(), enc, z, zb, z,
                                     strat, fanout_cap=self.fanout_cap,
                                     slot_cap=self.slot_cap,
                                     payload_cap=P)
                             else:
-                                r = route_window_delta_compact(
-                                    tables, dummy_delta(dC), cursors,
+                                r = rt(route_window_delta_compact)(
+                                    tables, dummy_delta(dC), wc(),
                                     enc, z, zb, z, strat,
                                     fanout_cap=self.fanout_cap,
                                     slot_cap=self.slot_cap,
@@ -2064,12 +2097,12 @@ class DeviceRouteEngine:
                                       slot_cap=self.slot_cap,
                                       payload_cap=P)
                             if dC is None:
-                                r = route_step_compact(
-                                    tables, cursors, enc[0], z[0],
+                                r = rt(route_step_compact)(
+                                    tables, wc(), enc[0], z[0],
                                     zb[0], z[0], strat, **kw)
                             else:
-                                r = route_step_delta_compact(
-                                    tables, dummy_delta(dC), cursors,
+                                r = rt(route_step_delta_compact)(
+                                    tables, dummy_delta(dC), wc(),
                                     enc[0], z[0], zb[0], z[0], strat,
                                     **kw, **dkw)
                     else:
@@ -2089,15 +2122,16 @@ class DeviceRouteEngine:
                             inv = np.zeros((Wp, Bp), np.int32)
                             mh = np.zeros((Wp, Bp), np.int32)
                             if dC is None:
-                                r = route_window_cached_compact(
-                                    tables, cursors, *args, *pos, inv,
+                                r = rt(route_window_cached_compact)(
+                                    tables, wc(), *args, *pos, inv,
                                     mh, strat,
                                     fanout_cap=self.fanout_cap,
                                     slot_cap=self.slot_cap,
                                     payload_cap=P)
                             else:
-                                r = route_window_delta_cached_compact(
-                                    tables, dummy_delta(dC), cursors,
+                                r = rt(
+                                    route_window_delta_cached_compact)(
+                                    tables, dummy_delta(dC), wc(),
                                     *args, *dargs, *pos, inv, mh,
                                     strat, fanout_cap=self.fanout_cap,
                                     slot_cap=self.slot_cap,
@@ -2111,12 +2145,12 @@ class DeviceRouteEngine:
                                       slot_cap=self.slot_cap,
                                       payload_cap=P)
                             if dC is None:
-                                r = route_step_cached_compact(
-                                    tables, cursors, *args, *pos, inv,
+                                r = rt(route_step_cached_compact)(
+                                    tables, wc(), *args, *pos, inv,
                                     mh, strat, **kw)
                             else:
-                                r = route_step_delta_cached_compact(
-                                    tables, dummy_delta(dC), cursors,
+                                r = rt(route_step_delta_cached_compact)(
+                                    tables, dummy_delta(dC), wc(),
                                     *args, *dargs, *pos, inv, mh,
                                     strat, **kw, **dkw)
                     jax.block_until_ready(r.compact.offsets)
@@ -2262,6 +2296,87 @@ class DeviceRouteEngine:
             except Exception:  # noqa: BLE001
                 pass
 
+    # ---- ISSUE 9: donation + async readback helpers ---------------------
+    def _rt(self, fn):
+        """The serving-path variant of a fused route program: at
+        dispatch_depth >= 2 the cursors slot is DONATED (the ping-pong
+        cursor buffers reuse HBM across windows; the output is
+        re-adopted under the snapshot identity guard in
+        _dispatch_inner). Depth 1 returns the plain program — the
+        pre-ISSUE-9 jit cache, bit-exact. The warm passes resolve
+        through this SAME chooser, so the program a class warms is the
+        program the serving path dispatches."""
+        if not self._pipelined:
+            return fn
+        from emqx_tpu.models.router_engine import donating
+        return donating(fn)
+
+    def _warm_cursors(self, cursors):
+        """Cursors argument for off-serving-path calls (class warms,
+        pre-swap warms): at dispatch_depth >= 2 the serving programs
+        donate their cursors slot, so a warm must never hand over a
+        live buffer — it passes a throwaway device_put zeros of the
+        same shape instead. Device-array inputs share the jit-cache
+        entry with the serving call's (device_put arrays and jit
+        outputs key identically; numpy inputs do NOT — measured), so
+        the warm still covers the serving class. Depth 1 passes the
+        live cursors through untouched, pre-ISSUE-9 exact. Reading
+        .shape is safe even when a racing dispatch already donated the
+        buffer away (aval metadata survives deletion)."""
+        if not self._pipelined:
+            return cursors
+        import jax
+        # hbm: transient — donated away by the warm call it feeds
+        return jax.device_put(np.zeros(cursors.shape, np.int32))
+
+    def _readback_planes(self, h) -> list:
+        """The device arrays materialize will transfer for this handle
+        — exactly those, so the async start never wastes link bandwidth
+        on planes the CSR compaction made redundant (a later overflow
+        fallback to the dense planes still transfers synchronously;
+        correctness never depends on the prefetch)."""
+        out = []
+        res, cp = h.res, h.cres
+        dp, dcp = h.dres, h.dcres
+        if dp is not None:
+            out += [dp.counts, dp.moverflow, dp.overflow]
+            if dcp is not None:
+                out += [dcp.offsets, dcp.counts3, dcp.row_overflow,
+                        dcp.payload]
+            else:
+                out += [dp.fids, dp.rows, dp.opts]
+        if cp is not None:
+            out += [cp.offsets, cp.counts3, cp.row_overflow, cp.payload,
+                    res.overflow, res.occur]
+        else:
+            out += [res.matches, res.rows, res.opts, res.shared_sids,
+                    res.shared_rows, res.shared_opts, res.overflow,
+                    res.occur]
+            if h.cache_info is not None and self._match_cache is not None:
+                out.append(res.match_counts)
+        return out
+
+    def _start_readback(self, h) -> None:
+        """ISSUE 9: start the device→host transfer of every plane
+        materialize will read, AT DISPATCH RETURN — the readback
+        crosses the link while dispatch(W+1) computes, and materialize
+        becomes consume-on-arrival. The in-flight result buffers
+        register with the HBM ledger under `pipeline_buffers` (they are
+        pinned HBM for up to dispatch_depth windows; release is
+        automatic when the handle dies). Backends without async copies
+        keep the synchronous transfer in materialize — the prefetch is
+        an overlap optimization, never a correctness input."""
+        if self.ledger is not None:
+            self._hold("pipeline_buffers",
+                       (h.res, h.cres, h.dres, h.dcres))
+        for a in self._readback_planes(h):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                return      # backend has no async copy: sync readback
+            except Exception:  # noqa: BLE001 — best-effort prefetch
+                return
+
     def dispatch(self, h) -> None:
         """Stage 2 (executor thread): run the jitted route step. On a
         dispatch relay this blocks on HTTP; on co-located hardware it is an
@@ -2286,6 +2401,11 @@ class DeviceRouteEngine:
                     self._dispatch_annotated(h)
             else:
                 self._dispatch_annotated(h)
+            if self._pipelined and h.res is not None:
+                # ISSUE 9: start the async readback while this thread
+                # still owns the dispatch slot — the transfer hides
+                # under the NEXT window's dispatch
+                self._start_readback(h)
         finally:
             if tele is not None:
                 tele.observe_stage(stage, time.perf_counter() - t0)
@@ -2375,10 +2495,11 @@ class DeviceRouteEngine:
             # shapes with every fused dimension disabled or cold)
             import jax.numpy as jnp
             outs = []
+            step_fn = self._rt(RE.route_step)
             for k in range(Wp):
-                r = RE.route_step(tables, cursors, enc4[k],
-                                  len4[k], dol4[k], msg_hash[k], strat,
-                                  **kw)
+                r = step_fn(tables, cursors, enc4[k],
+                            len4[k], dol4[k], msg_hash[k], strat,
+                            **kw)
                 cursors = r.new_cursors
                 outs.append(r)
             if self._tables is tables:   # no swap raced this dispatch
@@ -2408,15 +2529,15 @@ class DeviceRouteEngine:
                       else RE.route_window_delta_cached) if shapes else \
                     (RE.route_step_delta_cached_compact if P is not None
                      else RE.route_step_delta_cached)
-                out = fn(tables, ov.dev, cursors, *base,
-                         *dbase, *tail, **kw, **dkw, **ckw)
+                out = self._rt(fn)(tables, ov.dev, cursors, *base,
+                                   *dbase, *tail, **kw, **dkw, **ckw)
             else:
                 fn = (RE.route_window_cached_compact if P is not None
                       else RE.route_window_cached) if shapes else \
                     (RE.route_step_cached_compact if P is not None
                      else RE.route_step_cached)
-                out = fn(tables, cursors, *base, *tail,
-                         **kw, **ckw)
+                out = self._rt(fn)(tables, cursors, *base, *tail,
+                                   **kw, **ckw)
             self.node.metrics.inc("routing.device.cached_windows")
             warm_key = self._class_key(sig, Wp, Bp, Bm=p.Bm,
                                        dC=dC, P=P)
@@ -2428,15 +2549,15 @@ class DeviceRouteEngine:
                       else RE.route_window_delta) if shapes else \
                     (RE.route_step_delta_compact if P is not None
                      else RE.route_step_delta)
-                out = fn(tables, ov.dev, cursors, *args4,
-                         strat, **kw, **dkw, **ckw)
+                out = self._rt(fn)(tables, ov.dev, cursors, *args4,
+                                   strat, **kw, **dkw, **ckw)
             else:
                 fn = (RE.route_window_full_compact if P is not None
                       else RE.route_window_full) if shapes else \
                     RE.route_step_compact   # plain trie without P
                                             # returned above
-                out = fn(tables, cursors, *args4, strat,
-                         **kw, **ckw)
+                out = self._rt(fn)(tables, cursors, *args4, strat,
+                                   **kw, **ckw)
             warm_key = self._class_key(sig, Wp, Bp, dC=dC,
                                        P=P)
 
@@ -3076,7 +3197,17 @@ class DeviceRouteEngine:
 
     def abandon(self, h) -> None:
         """Release a handle ENTIRELY (error path: the caller falls back
-        to the host route for every remaining sub-batch). Idempotent."""
+        to the host route for every remaining sub-batch). Idempotent.
+
+        At dispatch_depth >= 2 the failed dispatch may have DONATED the
+        live cursors buffer before dying (jax invalidates donated
+        inputs at call time, success or not) and the adoption at the
+        end of _dispatch_inner never ran — without a reseed every
+        subsequent device dispatch would hit 'Array has been deleted'
+        until a snapshot swap happened to replace _cursors, permanently
+        degrading a static-subscription node to the host rung. The
+        reseed costs one round-robin fairness reset (same class of blip
+        as a swap racing a dispatch), never correctness."""
         if h is not None and h.built is not None:
             h.refs = 0
             h.built = None
@@ -3085,6 +3216,19 @@ class DeviceRouteEngine:
                 self.ledger.unpin(id(h))
             if self._building:
                 self._try_swap()
+        if self._pipelined:
+            cur = self._cursors
+            try:
+                deleted = cur is not None and cur.is_deleted()
+            except Exception:  # noqa: BLE001 — non-jax placeholder
+                deleted = False
+            if deleted:
+                import jax
+                self._cursors = self._hold(
+                    "snapshot_cursors",
+                    # hbm: reseed — the donating call consumed the
+                    # buffer and the failure path skipped adoption
+                    jax.device_put(np.zeros(cur.shape, np.int32)))
 
     def route_batch(self, msgs: list[Message]) -> Optional[list[int]]:
         """Route+deliver a micro-batch through the fused device step,
@@ -3360,6 +3504,7 @@ class DeviceRouteEngine:
             "match_cache": self._match_cache.stats()
             if self._match_cache is not None else None,
             "compact_readback": self.compact_readback,
+            "dispatch_depth": self.dispatch_depth,
             "payload_ewma": {k: round(v, 1)
                              for k, v in self._pay_ewma.items()},
             "delta_overlay": self.delta_overlay,

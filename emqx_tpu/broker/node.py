@@ -52,6 +52,14 @@ class Node:
         from emqx_tpu.broker.device_engine import resolve_rebuild_threshold
         rebuild_threshold = resolve_rebuild_threshold(
             perf.get("rebuild_threshold"))
+        # double-buffered window pipeline depth (ISSUE 9): one
+        # resolution shared by the batcher's settle ring and both
+        # engines' donation/async-readback gates. broker.dispatch_depth
+        # / EMQX_TPU_DISPATCH_DEPTH, config beats env beats default 2;
+        # =1 restores the synchronous pre-ISSUE-9 loop exactly.
+        from emqx_tpu.broker.batcher import resolve_dispatch_depth
+        dispatch_depth = resolve_dispatch_depth(
+            perf.get("dispatch_depth"))
         self.router = Router(
             use_device=use_device,
             rebuild_threshold=rebuild_threshold,
@@ -154,12 +162,14 @@ class Node:
                 # incremental (per-shard compaction) — the knob is
                 # accepted for config parity and surfaced in stats
                 delta_overlay=perf.get("delta_overlay"),
-                supervisor=self.supervisor)
+                supervisor=self.supervisor,
+                dispatch_depth=dispatch_depth)
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
                 max_batch=mc.get("max_batch", 256),
-                device_min_batch=perf.get("device_min_batch", 4))
+                device_min_batch=perf.get("device_min_batch", 4),
+                dispatch_depth=dispatch_depth)
         elif use_device:
             from emqx_tpu.broker.batcher import PublishBatcher
             from emqx_tpu.broker.device_engine import DeviceRouteEngine
@@ -178,12 +188,14 @@ class Node:
                 # delta-overlay A/B knob (ISSUE 4; None =
                 # EMQX_TPU_DELTA_OVERLAY / default-on)
                 delta_overlay=perf.get("delta_overlay"),
-                supervisor=self.supervisor)
+                supervisor=self.supervisor,
+                dispatch_depth=dispatch_depth)
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
                 max_batch=perf.get("max_publish_batch", 1024),
-                device_min_batch=perf.get("device_min_batch", 4))
+                device_min_batch=perf.get("device_min_batch", 4),
+                dispatch_depth=dispatch_depth)
         self.cm = ConnectionManager()
         self.cm.broker = self.broker
         self.banned = Banned()

@@ -699,6 +699,11 @@ def compile_stats() -> dict[str, int]:
             out[fn.__name__] = fn._cache_size()
         except Exception:  # noqa: BLE001 — cache introspection is best-effort
             pass
+    # the ISSUE-9 donating twins compile in their own caches but are
+    # the same programs — fold their entry counts into the plain names
+    # so the exported stats stay one name space at any dispatch depth
+    for name, n in donating_compile_stats().items():
+        out[name] = out.get(name, 0) + n
     return out
 
 
@@ -942,6 +947,125 @@ route_step_delta_cached_compact = \
     _with_cost_registry(route_step_delta_cached_compact)
 route_window_delta_cached_compact = \
     _with_cost_registry(route_window_delta_cached_compact)
+
+
+# ---- donating serving twins (ISSUE 9) -----------------------------------
+# At dispatch_depth >= 2 the serving dispatch threads its cursors through
+# the fused programs with the cursors slot DONATED (input-output aliasing:
+# the ping-pong cursor buffers reuse HBM instead of allocating one fresh
+# [G] array per window). Donation invalidates the caller's input buffer,
+# so these twins are used ONLY where the call site immediately re-adopts
+# the output under the snapshot identity guard (DeviceRouteEngine.
+# _dispatch_inner) and by the warm passes that feed them THROWAWAY
+# device_put buffers — never by tests/benches that reuse a cursors array
+# across calls (those keep the non-donating originals above). Each twin
+# shares the plain program's name in the cost registry (same program,
+# donated cursor slot) and its jit cache is counted into compile_stats
+# under the plain name. Stage-graph safe: donation is an annotation on
+# the public entry points, not a change to any stage composition —
+# ROADMAP item 2's builder can emit the same annotation per fused
+# program.
+#
+# Measured cache-key caveat this design encodes: numpy inputs and
+# device arrays do NOT share a jit-cache entry, while device_put arrays
+# and jit outputs DO — so every warm/probe call through a twin must pass
+# a fresh device_put zeros cursors (the engine's _warm_cursors), or the
+# first serving dispatch would re-trace in-path.
+
+_DONATE_STATICS = {
+    "route_step": ("frontier_cap", "match_cap", "fanout_cap",
+                   "slot_cap"),
+    "route_step_shapes": ("fanout_cap", "slot_cap"),
+    "route_window_full": ("fanout_cap", "slot_cap"),
+    "route_step_cached": ("frontier_cap", "match_cap", "fanout_cap",
+                          "slot_cap"),
+    "route_window_cached": ("fanout_cap", "slot_cap"),
+    "route_step_compact": ("frontier_cap", "match_cap", "fanout_cap",
+                           "slot_cap", "payload_cap"),
+    "route_step_cached_compact": ("frontier_cap", "match_cap",
+                                  "fanout_cap", "slot_cap",
+                                  "payload_cap"),
+    "route_window_full_compact": ("fanout_cap", "slot_cap",
+                                  "payload_cap"),
+    "route_window_cached_compact": ("fanout_cap", "slot_cap",
+                                    "payload_cap"),
+    "route_step_delta": ("frontier_cap", "match_cap", "fanout_cap",
+                         "slot_cap", "delta_match_cap",
+                         "delta_fanout_cap"),
+    "route_window_delta": ("fanout_cap", "slot_cap", "delta_match_cap",
+                           "delta_fanout_cap"),
+    "route_step_delta_cached": ("frontier_cap", "match_cap",
+                                "fanout_cap", "slot_cap",
+                                "delta_match_cap", "delta_fanout_cap"),
+    "route_window_delta_cached": ("fanout_cap", "slot_cap",
+                                  "delta_match_cap",
+                                  "delta_fanout_cap"),
+    "route_step_delta_compact": ("frontier_cap", "match_cap",
+                                 "fanout_cap", "slot_cap",
+                                 "delta_match_cap", "delta_fanout_cap",
+                                 "payload_cap", "d_payload_cap"),
+    "route_window_delta_compact": ("fanout_cap", "slot_cap",
+                                   "delta_match_cap",
+                                   "delta_fanout_cap", "payload_cap",
+                                   "d_payload_cap"),
+    "route_step_delta_cached_compact": ("frontier_cap", "match_cap",
+                                        "fanout_cap", "slot_cap",
+                                        "delta_match_cap",
+                                        "delta_fanout_cap",
+                                        "payload_cap", "d_payload_cap"),
+    "route_window_delta_cached_compact": ("fanout_cap", "slot_cap",
+                                          "delta_match_cap",
+                                          "delta_fanout_cap",
+                                          "payload_cap",
+                                          "d_payload_cap"),
+}
+
+_donating_cache: dict[str, object] = {}
+_donating_lock = threading.Lock()
+
+
+def donating(fn):
+    """The cursor-donating serving twin of a fused route program
+    (lazy, one jit per program for the process lifetime). `fn` is one
+    of the public programs above (cost-registry wrapped or not).
+    Locked: the dispatch executor and the background build/warm
+    threads both resolve twins through DeviceRouteEngine._rt — an
+    unlocked check-then-act could build rival twins and discard the
+    one whose jit cache the warm pass just populated (an in-path
+    recompile on the next serving dispatch)."""
+    name = fn.__name__
+    tw = _donating_cache.get(name)
+    if tw is None:
+        with _donating_lock:
+            tw = _donating_cache.get(name)
+            if tw is None:
+                import warnings
+                # backends without donation support warn per lowering;
+                # the fallback (a fresh output buffer per window) is
+                # exactly the pre-donation behavior, so the warning is
+                # noise there
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                raw = getattr(fn, "_fun", fn).__wrapped__
+                tw = _with_cost_registry(jax.jit(
+                    raw, static_argnames=_DONATE_STATICS[name],
+                    donate_argnames=("cursors",)))
+                _donating_cache[name] = tw
+    return tw
+
+
+def donating_compile_stats() -> dict[str, int]:
+    """Jit-cache entry counts of the instantiated donating twins,
+    keyed by the PLAIN program names (compile_stats merges them in —
+    one exported name space whatever depth the node serves at)."""
+    out = {}
+    for name, fn in _donating_cache.items():
+        try:
+            out[name] = fn._cache_size()
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            pass
+    return out
 
 
 def empty_router_tables(filter_cap: int = 16) -> RouterTables:

@@ -56,6 +56,19 @@ class Cap:
         return True
 
 
+class _Flat:
+    """Flatten a future-of-future (dispatch stage returning the
+    materialize future) into one result() — the flood's settle point."""
+
+    __slots__ = ("fut",)
+
+    def __init__(self, fut):
+        self.fut = fut
+
+    def result(self):
+        return self.fut.result().result()
+
+
 def main():
     import jax
 
@@ -98,23 +111,66 @@ def main():
         f"in {out['build_s']}s (caps {st['caps']})")
 
     # ---- 2. flood with oracle spot-checks ----------------------------
+    # ISSUE 9: the flood runs the PIPELINED dispatch loop the serving
+    # path now uses — dispatch runs on its own thread and materialize
+    # on another (the batcher's dispatch-pool/read-pool split), with up
+    # to EMQX_TPU_DISPATCH_DEPTH windows in flight; settle order stays
+    # FIFO and every batch's counts are still oracle-checked.
+    # EMQX_TPU_DISPATCH_DEPTH=1 restores the synchronous
+    # prepare→dispatch→materialize→finish round-trip exactly.
     import numpy as np
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    from emqx_tpu.broker.batcher import resolve_dispatch_depth
+    depth = resolve_dispatch_depth(None)
     rng = np.random.RandomState(11)
     n_batches = int(os.environ.get("BENCH_SHARDED_BATCHES", 40))
+    # mesh warm/ready before the timed window (route_batch wait=True
+    # used to do this implicitly on the first flood batch)
+    eng.route_batch([make("p", 0, "dev/d0/x/n0/t", b"x")] * B,
+                    wait=True)
+    disp_pool = ThreadPoolExecutor(1, thread_name_prefix="bench-disp")
+    read_pool = ThreadPoolExecutor(1, thread_name_prefix="bench-read")
     t0 = time.time()
     routed = 0
+    inflight: deque = deque()
+
+    def settle(rec):
+        nonlocal routed
+        bi, h, mat_fut = rec
+        mat_fut.result()
+        counts = eng.finish(h)
+        assert counts == [1] * B, f"batch {bi}: {counts[:8]}..."
+        routed += B
+
     for bi in range(n_batches):
         i_ = rng.randint(0, ids, B)
         n_ = rng.randint(0, nums, B)
         msgs = [make("p", 0, f"dev/d{i}/x/n{n}/t", b"x")
                 for i, n in zip(i_, n_)]
-        counts = eng.route_batch(msgs, wait=True)
-        assert counts == [1] * B, f"batch {bi}: {counts[:8]}..."
-        routed += B
+        while len(inflight) >= depth:
+            settle(inflight.popleft())
+        h = eng.prepare(msgs)
+        assert h is not None, f"mesh stood down at batch {bi}"
+
+        def stages(h=h):
+            eng.dispatch(h)
+            return read_pool.submit(eng.materialize, h)
+
+        # dispatch(W+1) launches while materialize(W)/finish(W) run
+        dfut = disp_pool.submit(stages)
+        inflight.append((bi, h, _Flat(dfut)))
+    while inflight:
+        settle(inflight.popleft())
     dt = time.time() - t0
+    disp_pool.shutdown(wait=False)
+    read_pool.shutdown(wait=False)
     out["flood"] = {"msgs": routed, "per_s": round(routed / dt),
-                    "wall_s": round(dt, 2)}
-    log(f"flood: {routed} msgs in {dt:.1f}s = {routed / dt:.0f}/s")
+                    "wall_s": round(dt, 2),
+                    "dispatch_depth": depth}
+    log(f"flood: {routed} msgs in {dt:.1f}s = {routed / dt:.0f}/s "
+        f"(depth {depth})")
 
     # ---- 3. churn while serving --------------------------------------
     t0 = time.time()
