@@ -30,7 +30,9 @@ BENCH_INIT_TIMEOUT_S=600 — set it low to stop burning a round's budget
 polling a relay that never comes up; a PROVABLY dead port now skips the
 poll entirely via the relay_watcher preflight, BENCH_RELAY_PREFLIGHT=0
 restores the wait), BENCH_FANOUT (=0 skips the delivery-lane fan-out
-row; tools/fanout_bench.py knobs FANOUT_*), BENCH_CHECKPOINT /
+row; tools/fanout_bench.py knobs FANOUT_*), BENCH_INGRESS (=0 skips
+the columnar-ingress e2e twin row; tools/ingress_bench.py knobs
+INGRESS_*), BENCH_CHECKPOINT /
 BENCH_RESUME (resumable phase ladder: each phase's JSON commits to disk
 as it completes and a restarted bench resumes from the checkpoint —
 BENCH_RESUME=0 starts fresh), BENCH_HBM (=0 skips the HBM capacity
@@ -1681,7 +1683,7 @@ def main():
     # legitimately differ between the dying run and its resume).
     knob_env = {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("BENCH_", "FANOUT_", "CHURN_",
-                                 "SKEW_", "EMQX_TPU_"))
+                                 "SKEW_", "INGRESS_", "EMQX_TPU_"))
                 and k not in ("BENCH_CHECKPOINT", "BENCH_RESUME")}
     sig = {"subs": requested, "batch": B, "window": window,
            "shared_pct": shared_pct, "env": knob_env}
@@ -2036,6 +2038,49 @@ def main():
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log(f"fanout bench failed: {type(e).__name__}: {e}")
                     result["fanout_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if "ingress" in phases:
+                result["ingress"] = phases["ingress"]
+                log("ingress: resumed from checkpoint")
+            elif os.environ.get("BENCH_INGRESS", "1") != "0":
+                # columnar-ingress e2e microbench (ISSUE 11): real TCP
+                # many-connection flood, columnar vs per-packet twin
+                # rows + connection-count sweep, CPU subprocess like
+                # the skew/churn/fanout rows — checkpointed the moment
+                # it completes, so a dying relay window still commits
+                # the ingress number
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    with _phase_clock("ingress"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "ingress_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_INGRESS_TIMEOUT_S", 1500)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        # keep the row compact: the twin table + the
+                        # ingress section are the interesting slices;
+                        # the per-stage decompositions stay for the
+                        # honest-number requirement
+                        result["ingress"] = row
+                        _ckpt_put("ingress", row, sig, phases)
+                    else:
+                        result["ingress_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"ingress bench failed: "
+                        f"{type(e).__name__}: {e}")
+                    result["ingress_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             # where the round's minutes went (ISSUE 7 satellite):
             # per-phase wall seconds + relay/backend-init wait, in the

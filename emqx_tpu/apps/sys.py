@@ -78,6 +78,9 @@ class SysBroker:
         supervision: breaker states, ladder rung, ISSUE 6) /
         `pipeline/trace` (window-causal flight recorder: ring state +
         dispatch↔materialize overlap + bubble attribution, ISSUE 7) /
+        `pipeline/ingress` (columnar PUBLISH ingress: burst sizes,
+        columnar-vs-fallback frames, per-acceptor-lane accepts,
+        ISSUE 11) /
         `pipeline/memory` (HBM ledger: per-category device bytes, pin
         ages, backend memory_stats cross-check, ISSUE 8) /
         `pipeline/program_costs` (jit-program cost registry: compile
@@ -97,8 +100,8 @@ class SysBroker:
         self._pub("pipeline/decisions",
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
-                        "deliver", "supervise", "trace", "memory",
-                        "program_costs"):
+                        "deliver", "supervise", "trace", "ingress",
+                        "memory", "program_costs"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

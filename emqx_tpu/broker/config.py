@@ -90,6 +90,17 @@ DEFAULTS: dict = {
         # baked-in bool here would shadow the env knob through the
         # defaults merge.
         "hbm_ledger": None,
+        # None = resolve via EMQX_TPU_COLUMNAR_INGRESS, then default-on
+        # (broker/connection.resolve_columnar_ingress); false restores
+        # the per-packet PUBLISH ingress path exactly — parser.feed,
+        # per-packet handle_in, one accept loop, no `ingress` telemetry
+        # section (the ISSUE-11 A/B baseline). A baked-in bool here
+        # would shadow the env knob through the defaults merge.
+        "columnar_ingress": None,
+        # sharded SO_REUSEPORT acceptor lanes per TCP listener (None =
+        # EMQX_TPU_INGRESS_LANES, then min(4, cpus); must be >= 1;
+        # columnar_ingress=0 forces 1)
+        "ingress_lanes": None,
         # stale-pin sentinel threshold in windows (None =
         # EMQX_TPU_PIN_WARN_WINDOWS, then 64; must be > 0): a dispatch
         # handle pinning its snapshot longer than this fires the

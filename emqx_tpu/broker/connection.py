@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import socket
 import time
 from typing import Optional
 
@@ -21,11 +23,48 @@ from emqx_tpu.broker.limiter import (ConnectionLimiter, ForceShutdownPolicy,
                                      TokenBucket)
 from emqx_tpu.mqtt import constants as C
 from emqx_tpu.mqtt import packet as P
-from emqx_tpu.mqtt.frame import FrameError, FrameParser, serialize
+from emqx_tpu.mqtt.frame import (FrameError, FrameParser, PublishBurst,
+                                 serialize)
 
 log = logging.getLogger("emqx_tpu.connection")
 
 READ_CHUNK = 65536
+
+
+def resolve_columnar_ingress(configured=None) -> bool:
+    """The one columnar-ingress resolution (ISSUE 11): config
+    (``broker.columnar_ingress``) beats ``EMQX_TPU_COLUMNAR_INGRESS``
+    beats default-on. ``=0`` restores the per-packet ingress path
+    EXACTLY — parser.feed, per-packet handle_in, one accept loop, no
+    ``ingress`` telemetry section — the A/B baseline the twin test
+    compares."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_COLUMNAR_INGRESS", "1") \
+        not in ("0", "false", "off")
+
+
+def resolve_ingress_lanes(configured=None) -> int:
+    """Sharded-acceptor lane count: config (``broker.ingress_lanes``)
+    beats ``EMQX_TPU_INGRESS_LANES`` beats the built-in min(4, cpus).
+    1 = the single accept loop; the whole layer additionally rides the
+    columnar_ingress knob (=0 forces 1 lane). Must be a positive
+    integer — anything else is a deployment error worth failing loudly
+    on."""
+    if configured is not None:
+        val = int(configured)
+    else:
+        env = os.environ.get("EMQX_TPU_INGRESS_LANES")
+        if env is None:
+            return min(4, os.cpu_count() or 1)
+        try:
+            val = int(env)
+        except ValueError:
+            raise ValueError(
+                f"EMQX_TPU_INGRESS_LANES={env!r} is not an integer")
+    if val < 1:
+        raise ValueError(f"ingress_lanes must be >= 1, got {val}")
+    return val
 
 
 class Connection:
@@ -41,6 +80,10 @@ class Connection:
         self.parser = FrameParser(
             max_size=node.config.mqtt(zone).get("max_packet_size"),
             strict=node.config.mqtt(zone).get("strict_mode", False))
+        # columnar ingress (ISSUE 11): resolved once per node; off means
+        # this connection's read loop is byte-for-byte the per-packet
+        # path (parser.feed + handle_in, no ingress counters)
+        self._columnar = bool(getattr(node, "columnar_ingress", False))
         self.channel = Channel(
             node, {"peername": peer, "sockname": sock, "zone": zone,
                    "peercert": peercert},
@@ -104,33 +147,63 @@ class Connection:
                     reason = "closed"
                     break
                 self.last_rx = time.monotonic()
-                self.node.metrics.inc("bytes.received", len(data))
+                m = self.node.metrics
+                m.inc("bytes.received", len(data))
+                columnar = self._columnar
                 try:
-                    pkts = self.parser.feed(data)
+                    if columnar:
+                        # columnar ingress (ISSUE 11): PUBLISH runs
+                        # decode as PublishBurst items, everything else
+                        # (and small reads) stays per-packet, in order
+                        items = self.parser.feed_columnar(data)
+                    else:
+                        items = self.parser.feed(data)
                 except FrameError as e:
                     reason = f"frame_error:{e.code}"
                     self._frame_error_out(e)
                     break
-                for i, pkt in enumerate(pkts):
+                n_rows = sum(len(it) if type(it) is PublishBurst else 1
+                             for it in items)
+                if columnar and items:
+                    m.inc("pipeline.ingress.bytes", len(data))
+                n_done = 0
+                for item in items:
+                    if type(item) is PublishBurst:
+                        m.inc("pipeline.ingress.bursts")
+                        m.inc("pipeline.ingress.rows", len(item))
+                        tele = self.node.pipeline_telemetry
+                        if tele is not None:
+                            tele.record_ingress_burst(len(item))
+                        try:
+                            await self.channel.handle_publish_burst(item)
+                        except ProtocolError as e:
+                            reason = f"protocol_error:0x{e.rc:02x}"
+                            self._protocol_error_out(e)
+                            break
+                        n_done += len(item)
+                        continue
+                    if columnar:
+                        m.inc("pipeline.ingress.fallback_frames")
                     try:
-                        await self.channel.handle_in(pkt)
+                        await self.channel.handle_in(item)
                     except ProtocolError as e:
                         reason = f"protocol_error:0x{e.rc:02x}"
                         self._protocol_error_out(e)
                         break
-                    if i % 64 == 63:
+                    n_done += 1
+                    if n_done % 64 == 0:
                         # one read can carry hundreds of frames; without
                         # a scheduling point the whole burst handles
                         # back-to-back and stalls every other task for
                         # tens of ms (handle_in's awaits don't yield
                         # unless they actually block)
                         await asyncio.sleep(0)
-                if pkts:
+                if items:
                     await self._drain()
                     # ingress rate limit: a depleted bucket pauses reading
                     # (the {active,N}-off backpressure, emqx_connection
                     # ensure_rate_limit)
-                    pause = self.limiter.check(len(pkts), len(data))
+                    pause = self.limiter.check(n_rows, len(data))
                     if pause > 0:
                         self.node.metrics.inc("connection.rate_limited")
                         await asyncio.sleep(pause)
@@ -230,6 +303,8 @@ class Listener:
             self.name = "ssl:default"
         self.max_connections = max_connections
         self._server: Optional[asyncio.AbstractServer] = None
+        self._lane_servers: list[asyncio.AbstractServer] = []
+        self.lane_conns: list[int] = []    # live conns per accept lane
         self._conns: set[asyncio.Task] = set()
         self.current_conns = 0
         rate = (node.config.get_zone(zone, "rate_limit") or {}) \
@@ -257,11 +332,53 @@ class Listener:
             self.current_conns -= 1
             self._conns.discard(task)
 
+    def _ingress_lanes(self) -> int:
+        """Acceptor-lane count for this listener (ISSUE 11): N
+        SO_REUSEPORT listening sockets on the same port, each with its
+        own accept loop, so the kernel spreads incoming connections —
+        the ingress mirror of PR 5's egress lanes. Engages only for
+        plain IPv4 TCP with columnar ingress on; TLS/IPv6 keep the
+        single accept loop."""
+        if not getattr(self.node, "columnar_ingress", False):
+            return 1
+        if self.ssl_opts or ":" in self.bind \
+                or not hasattr(socket, "SO_REUSEPORT"):
+            return 1
+        return getattr(self.node, "ingress_lanes", 1)
+
     async def start(self) -> None:
         ssl_ctx = None
         if self.ssl_opts:
             from emqx_tpu.utils.tls import make_server_context
             ssl_ctx = make_server_context(self.ssl_opts)
+        lanes = self._ingress_lanes()
+        if lanes > 1:
+            port = self.port
+            self.lane_conns = [0] * lanes
+            for i in range(lanes):
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEADDR, 1)
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
+                    sock.bind((self.bind, port))
+                except OSError:
+                    sock.close()
+                    if i == 0:
+                        raise
+                    break   # partial lane set still serves
+                if port == 0:   # ephemeral port: later lanes join it
+                    port = sock.getsockname()[1]
+                srv = await asyncio.start_server(
+                    self._lane_handler(i), sock=sock)
+                self._lane_servers.append(srv)
+            self.port = port
+            self._server = self._lane_servers[0]
+            log.info("listener %s started on %s:%d (%d ingress lanes)",
+                     self.name, self.bind, self.port,
+                     len(self._lane_servers))
+            return
         self._server = await asyncio.start_server(
             self._on_client, self.bind, self.port, ssl=ssl_ctx)
         if self.port == 0:   # ephemeral port for tests
@@ -269,18 +386,32 @@ class Listener:
         log.info("listener %s started on %s:%d", self.name, self.bind,
                  self.port)
 
+    def _lane_handler(self, lane: int):
+        async def _on_lane_client(reader, writer):
+            self.node.metrics.inc(
+                f"pipeline.ingress.lane{lane}.accepted")
+            self.lane_conns[lane] += 1
+            try:
+                await self._on_client(reader, writer)
+            finally:
+                self.lane_conns[lane] -= 1
+        return _on_lane_client
+
     async def stop(self) -> None:
         # stop accepting first so no connection slips in during the cancel
         # window; then cancel handlers (py3.12 wait_closed blocks until
         # every handler coroutine finishes, so cancel before waiting)
-        if self._server:
-            self._server.close()
+        servers = self._lane_servers or \
+            ([self._server] if self._server else [])
+        for srv in servers:
+            srv.close()
         for t in list(self._conns):
             t.cancel()
         if self._conns:
             await asyncio.gather(*self._conns, return_exceptions=True)
-        if self._server:
+        for srv in servers:
             try:
-                await asyncio.wait_for(self._server.wait_closed(), 2)
+                await asyncio.wait_for(srv.wait_closed(), 2)
             except asyncio.TimeoutError:
                 pass
+        self._lane_servers = []

@@ -616,6 +616,15 @@ class DeviceRouteEngine:
         self._touched: set[str] = set()
         self._built_deleted: set[str] = set()  # snapshot tombstones
         self._enc_cache: dict[str, list] = {}  # filter -> interned words
+        # columnar-ingress burst pre-encode (ISSUE 11): one vectorized
+        # native intern pass over a read burst's unique topics, consumed
+        # by prepare_window's gather path. Guarded by the intern-table
+        # length — intern ids are append-only, so an unchanged length
+        # proves the cached rows are what a fresh encode would produce
+        # (a filter word interned between burst and window would turn a
+        # cached UNKNOWN stale — the guard drops the whole memo then).
+        self._burst_enc = None          # (idx: dict, enc, lens, dollar,
+                                        #  too_long, intern_len)
 
         # fault-domain supervision (ISSUE 6): injection points at every
         # stage boundary, breaker-gated degradation (the reuse layers
@@ -2174,6 +2183,40 @@ class DeviceRouteEngine:
                                           self.node.metrics)
 
 
+    def preencode_burst(self, topics: list) -> None:
+        """ISSUE 11: intern a read burst's topics in ONE vectorized
+        native pass (split + hash + id-probe in C over the unique
+        strings), memoized for prepare_window's encode. The memo is
+        replaced wholesale per burst (no growth) and is only consumed
+        while the intern table length is unchanged — intern ids are
+        append-only, so equal length proves bit-identical encodings."""
+        from emqx_tpu.ops.match import encode_topics_str
+        uniq = list(dict.fromkeys(topics))
+        try:
+            enc, lens, dollar, too_long = encode_topics_str(
+                self.intern, uniq, self.max_levels)
+        except Exception:  # noqa: BLE001 — a failed pre-encode only
+            self._burst_enc = None        # means the window re-encodes
+            return
+        self._burst_enc = ({t: i for i, t in enumerate(uniq)},
+                           enc, lens, dollar, too_long,
+                           len(self.intern))
+
+    def _encode_publish_batch(self, topics: list):
+        """One batch's topic encode: the burst memo's vectorized gather
+        when every topic pre-encoded under the current intern length,
+        else the normal one-native-call path (bit-identical outputs
+        either way — the memo IS a cache of that call)."""
+        from emqx_tpu.ops.match import encode_topics_str
+        be = self._burst_enc
+        if be is not None and be[5] == len(self.intern):
+            idx_map, enc, lens, dollar, too_long = be[:5]
+            idxs = [idx_map.get(t, -1) for t in topics]
+            if -1 not in idxs:
+                return (enc[idxs], lens[idxs], dollar[idxs],
+                        too_long[idxs])
+        return encode_topics_str(self.intern, topics, self.max_levels)
+
     def prepare_window(self, lives: list[list[Message]],
                        gate_cold: bool = True):
         """Stage 1 (event loop): encode 1..W micro-batches as one fused
@@ -2196,16 +2239,17 @@ class DeviceRouteEngine:
             return None
         self._kick_class_warm()
         b = self._built
-        from emqx_tpu.ops.match import encode_topics_str
         subs = []
         encs = []
         Bp = 64
         for msgs in lives:
-            # one native call per batch (split+hash+probe in C); word
-            # lists are tokenized lazily in _consume_one only when the
-            # delta-trie path actually needs them
-            enc, lens, dollar, too_long = encode_topics_str(
-                self.intern, [m.topic for m in msgs], self.max_levels)
+            # one native call per batch (split+hash+probe in C) — or
+            # the burst memo's gather when submit_burst pre-encoded
+            # this burst's topics (ISSUE 11); word lists are tokenized
+            # lazily in _consume_one only when the delta-trie path
+            # actually needs them
+            enc, lens, dollar, too_long = self._encode_publish_batch(
+                [m.topic for m in msgs])
             subs.append((msgs, None, too_long))
             encs.append((enc, lens, dollar))
             Bp = max(Bp, self._batch_class(len(msgs)))

@@ -58,6 +58,16 @@ class GuidGen:
             seq = next(self._seq) & 0xFFFF
         return (ts << 64) | (self._node << 16) | seq
 
+    def next_batch(self, n: int) -> list:
+        """Reserve n GUIDs in ONE locked pass — the columnar burst
+        path's allocation (a per-message lock + clock read was the
+        single largest row cost in the ingest profile). Same layout and
+        monotonicity as n next() calls within one microsecond tick."""
+        with self._lock:
+            base = (time.time_ns() // 1000 << 64) | (self._node << 16)
+            seq = self._seq
+            return [base | (next(seq) & 0xFFFF) for _ in range(n)]
+
 
 _GUID = GuidGen()
 
@@ -180,3 +190,9 @@ def make(from_: str, qos: int, topic: str, payload: bytes,
     """Parity: emqx_message:make/4."""
     return Message(topic=topic, payload=payload, qos=qos, from_=from_,
                    flags=dict(flags or {}), headers=dict(headers or {}))
+
+
+def guid_batch(n: int) -> list:
+    """n GUIDs from the process generator in one locked pass (the
+    columnar ingress burst allocation)."""
+    return _GUID.next_batch(n)

@@ -60,6 +60,20 @@ class Node:
         from emqx_tpu.broker.batcher import resolve_dispatch_depth
         dispatch_depth = resolve_dispatch_depth(
             perf.get("dispatch_depth"))
+        # columnar zero-copy PUBLISH ingress (ISSUE 11): one resolution
+        # for the whole layer — the native burst decode in the codec,
+        # the channel's burst hand-off, the batcher's submit_burst and
+        # the sharded acceptor lanes all read these two node attributes.
+        # broker.columnar_ingress / EMQX_TPU_COLUMNAR_INGRESS, config
+        # beats env beats default-on; =0 restores the per-packet ingress
+        # path EXACTLY (single accept loop, parser.feed, per-packet
+        # handle_in, no `ingress` telemetry section).
+        from emqx_tpu.broker.connection import (resolve_columnar_ingress,
+                                                resolve_ingress_lanes)
+        self.columnar_ingress = resolve_columnar_ingress(
+            perf.get("columnar_ingress"))
+        self.ingress_lanes = resolve_ingress_lanes(
+            perf.get("ingress_lanes")) if self.columnar_ingress else 1
         self.router = Router(
             use_device=use_device,
             rebuild_threshold=rebuild_threshold,

@@ -210,6 +210,19 @@ class PipelineTelemetry:
                           lo=_STAGE_LO,
                           n_buckets=_STAGE_BUCKETS).observe(seconds)
 
+    # ---- columnar ingress (ISSUE 11) ------------------------------------
+    def record_ingress_burst(self, rows: int) -> None:
+        """One columnar-decoded PublishBurst of `rows` PUBLISH frames:
+        feeds the burst-size histogram (pipeline.ingress.burst). The
+        companion counters — pipeline.ingress.bursts / rows /
+        fallback_frames / bytes and the per-lane
+        pipeline.ingress.lane{i}.accepted family — are incremented at
+        the connection read loop; everything rides the shared registry,
+        so all four exporters carry them with zero coupling here."""
+        self.metrics.hist("pipeline.ingress.burst",
+                          lo=1.0, n_buckets=16,
+                          unit="rows").observe(rows)
+
     # ---- occupancy -------------------------------------------------------
     def record_occupancy(self, cls: str, fill: float) -> None:
         """Fill fraction of one dispatched batch within its padded shape
@@ -464,6 +477,34 @@ class PipelineTelemetry:
                 trace = self.recorder.snapshot_section()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # columnar ingress (ISSUE 11): burst/row/fallback counters, the
+        # burst-size histogram and per-acceptor-lane accept counts —
+        # the section ingress_bench and the twin rows read. Derived
+        # purely from traffic: with broker.columnar_ingress=0 nothing
+        # increments, so the section is absent exactly as pre-ISSUE-11.
+        ingress = {}
+        for k in ("bursts", "rows", "fallback_frames", "bytes"):
+            v = self.metrics.val(f"pipeline.ingress.{k}")
+            if v:
+                ingress[k] = v
+        rows_c = ingress.get("rows", 0)
+        fb = ingress.get("fallback_frames", 0)
+        if rows_c or fb:
+            ingress["columnar_ratio"] = round(rows_c / (rows_c + fb), 4)
+        bh = self.metrics.histograms().get("pipeline.ingress.burst")
+        if bh is not None and bh.count:
+            snap = bh.snapshot()
+            ingress["burst_rows"] = {
+                "count": snap["count"],
+                "mean": round(snap["mean"], 2),
+                "p50": round(snap["p50"], 2),
+                "p95": round(snap["p95"], 2),
+            }
+        lanes_acc = {k.split(".")[2]: v
+                     for k, v in self.metrics.all().items()
+                     if k.startswith("pipeline.ingress.lane") and v}
+        if lanes_acc:
+            ingress["lanes"] = lanes_acc
         # HBM ledger (ISSUE 8): per-category device bytes + peak
         # watermarks + pin ages + the backend memory_stats cross-check
         # — the section that makes "does it fit?" answerable before
@@ -495,6 +536,8 @@ class PipelineTelemetry:
             out["readback"] = readback
         if trace or full:
             out["trace"] = trace
+        if ingress or full:
+            out["ingress"] = ingress
         if memory or full:
             out["memory"] = memory
         jc = _jit_cache_sizes()

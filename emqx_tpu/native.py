@@ -67,6 +67,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.topic_match.restype = ctypes.c_int
     lib.topic_match.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                 ctypes.c_char_p, ctypes.c_size_t]
+    lib.mqtt_publish_decode_columnar.restype = ctypes.c_int
+    lib.mqtt_publish_decode_columnar.argtypes = [
+        u8p, ctypes.c_size_t, u32p, u32p, ctypes.c_int, ctypes.c_int,
+        u8p, u8p, u32p, u32p, u32p, u32p, u32p, u32p, u32p]
     lib.replayq_scan.restype = ctypes.c_int
     lib.replayq_scan.argtypes = [u8p, ctypes.c_size_t, u32p, u32p,
                                  ctypes.c_int]
@@ -98,29 +102,84 @@ class FrameScanError(Exception):
     pass
 
 
-def frame_scan(buf: bytes, max_frames: int = 256,
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _buf_arg(buf):
+    """A ctypes-passable view of any buffer-protocol object WITHOUT
+    copying it: writable buffers (bytearray, memoryview of one) go
+    through from_buffer; immutable bytes ride the c_char_p fast path
+    (CPython passes the object's internal pointer). The pre-ISSUE-11
+    bindings did from_buffer_copy, which made every burst scan copy the
+    whole read buffer before the C code even ran."""
+    if isinstance(buf, bytes):
+        return ctypes.cast(ctypes.c_char_p(buf), _U8P)
+    try:
+        return (ctypes.c_uint8 * len(buf)).from_buffer(buf)
+    except (TypeError, ValueError):   # read-only memoryview etc.
+        return (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+
+
+def frame_scan(buf, max_frames: int = 256,
                max_frame_size: int = 0) -> tuple[list[tuple[int, int]],
                                                  int]:
     """Split a byte buffer into complete MQTT frames.
 
-    Returns ([(offset, length), ...], consumed). Raises FrameScanError on
-    a malformed varint or an oversized frame."""
+    Accepts any buffer-protocol object (bytes / bytearray / memoryview)
+    — bytearray and memoryview are scanned in place, no copy. Returns
+    ([(offset, length), ...], consumed). Raises FrameScanError on a
+    malformed varint or an oversized frame."""
     lib = _load()
     if lib is None:
         return _frame_scan_py(buf, max_frames, max_frame_size)
     n = len(buf)
-    arr = (ctypes.c_uint8 * n).from_buffer_copy(buf) if n else \
-        (ctypes.c_uint8 * 1)()
+    arr = _buf_arg(buf) if n else (ctypes.c_uint8 * 1)()
     off = (ctypes.c_uint32 * max_frames)()
     lens = (ctypes.c_uint32 * max_frames)()
     consumed = ctypes.c_size_t(0)
     rc = lib.mqtt_frame_scan(arr, n, off, lens, max_frames,
                              max_frame_size, ctypes.byref(consumed))
+    # release the from_buffer export BEFORE any raise: a traceback
+    # holding this frame would otherwise pin the caller's bytearray
+    # ("Existing exports of data") through its error handling
+    del arr
     if rc == -1:
         raise FrameScanError("malformed varint")
     if rc == -2:
         raise FrameScanError("frame too large")
     return ([(off[i], lens[i]) for i in range(rc)], consumed.value)
+
+
+def frame_scan_np(buf, max_frames: int = 4096, max_frame_size: int = 0):
+    """frame_scan returning numpy arrays — the columnar ingress form:
+    (off uint32[n], length uint32[n], consumed). No per-frame tuples,
+    no buffer copy. Works with or without the native library (the
+    python fallback builds the same arrays)."""
+    import numpy as np
+    lib = _load()
+    if lib is None:
+        frames, consumed = _frame_scan_py(buf, max_frames,
+                                          max_frame_size)
+        off = np.fromiter((f[0] for f in frames), np.uint32,
+                          len(frames))
+        lens = np.fromiter((f[1] for f in frames), np.uint32,
+                           len(frames))
+        return off, lens, consumed
+    n = len(buf)
+    arr = _buf_arg(buf) if n else (ctypes.c_uint8 * 1)()
+    off = np.empty(max_frames, np.uint32)
+    lens = np.empty(max_frames, np.uint32)
+    consumed = ctypes.c_size_t(0)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    rc = lib.mqtt_frame_scan(arr, n, off.ctypes.data_as(u32p),
+                             lens.ctypes.data_as(u32p), max_frames,
+                             max_frame_size, ctypes.byref(consumed))
+    del arr   # release the buffer export before any raise (see above)
+    if rc == -1:
+        raise FrameScanError("malformed varint")
+    if rc == -2:
+        raise FrameScanError("frame too large")
+    return off[:rc], lens[:rc], consumed.value
 
 
 def _frame_scan_py(buf: bytes, max_frames: int,
@@ -157,6 +216,143 @@ def _frame_scan_py(buf: bytes, max_frames: int,
         pos += total
         consumed = pos
     return out, consumed
+
+
+# ---------------------------------------------------------------------
+# columnar PUBLISH decode (ISSUE 11)
+# ---------------------------------------------------------------------
+def publish_decode_columnar(buf, off, lens, v5: bool):
+    """Decode all PUBLISH frames among the scanned boundaries in one
+    pass. `off`/`lens` are the uint32 numpy arrays from frame_scan_np;
+    returns a dict of parallel numpy arrays:
+
+        kind        uint8[n]   1 = columnar-decoded PUBLISH; 0 = hand
+                               this frame to the strict per-packet
+                               parser (non-PUBLISH, or a PUBLISH the
+                               strict parser must reject precisely)
+        flags       uint8[n]   fixed-header nibble (bit0 retain,
+                               bits1-2 qos, bit3 dup)
+        topic_off / topic_len / packet_id / props_off / props_len /
+        payload_off / payload_len          uint32[n], absolute into buf
+
+    kind=0 rows are all-zero in every other array, native and fallback
+    alike — the differential fuzz suite compares them array-for-array.
+    UTF-8 topic validation and v5 property-content parsing stay with
+    the caller (it owns the resulting python objects)."""
+    import numpy as np
+    n = len(off)
+    out = {
+        "kind": np.zeros(n, np.uint8),
+        "flags": np.zeros(n, np.uint8),
+        "topic_off": np.zeros(n, np.uint32),
+        "topic_len": np.zeros(n, np.uint32),
+        "packet_id": np.zeros(n, np.uint32),
+        "props_off": np.zeros(n, np.uint32),
+        "props_len": np.zeros(n, np.uint32),
+        "payload_off": np.zeros(n, np.uint32),
+        "payload_len": np.zeros(n, np.uint32),
+    }
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is None:
+        return _publish_decode_columnar_py(buf, off, lens, v5, out)
+    off = np.ascontiguousarray(off, np.uint32)
+    lens = np.ascontiguousarray(lens, np.uint32)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mqtt_publish_decode_columnar(
+        _buf_arg(buf), len(buf), off.ctypes.data_as(u32p),
+        lens.ctypes.data_as(u32p), n, 1 if v5 else 0,
+        out["kind"].ctypes.data_as(u8p),
+        out["flags"].ctypes.data_as(u8p),
+        out["topic_off"].ctypes.data_as(u32p),
+        out["topic_len"].ctypes.data_as(u32p),
+        out["packet_id"].ctypes.data_as(u32p),
+        out["props_off"].ctypes.data_as(u32p),
+        out["props_len"].ctypes.data_as(u32p),
+        out["payload_off"].ctypes.data_as(u32p),
+        out["payload_len"].ctypes.data_as(u32p))
+    return out
+
+
+def _publish_decode_columnar_py(buf, off, lens, v5: bool, out):
+    """Pure-python mirror of the C decoder — bit-identical semantics
+    (the repo's established fallback-parity pattern; the differential
+    fuzz suite asserts array equality against the native build)."""
+    kind = out["kind"]
+    flags = out["flags"]
+    topic_off = out["topic_off"]
+    topic_len = out["topic_len"]
+    packet_id = out["packet_id"]
+    props_off = out["props_off"]
+    props_len = out["props_len"]
+    payload_off = out["payload_off"]
+    payload_len = out["payload_len"]
+    blen = len(buf)
+    for i in range(len(off)):
+        s = int(off[i])
+        e = s + int(lens[i])
+        if e > blen or lens[i] < 2:
+            continue
+        b0 = buf[s]
+        if (b0 >> 4) != 3:
+            continue
+        qos = (b0 >> 1) & 0x3
+        if qos == 3:
+            continue
+        p = s + 1
+        nb = 0
+        while p < e and nb < 4:
+            b = buf[p]
+            p += 1
+            nb += 1
+            if not (b & 0x80):
+                break
+        if p + 2 > e:
+            continue
+        tl = (buf[p] << 8) | buf[p + 1]
+        p += 2
+        if p + tl > e:
+            continue
+        t_off = p
+        p += tl
+        pid = 0
+        if qos > 0:
+            if p + 2 > e:
+                continue
+            pid = (buf[p] << 8) | buf[p + 1]
+            p += 2
+            if pid == 0:
+                continue
+        pr_off = pr_len = 0
+        if v5:
+            pl, mult, k, done = 0, 1, 0, False
+            while p < e and k < 4:
+                b = buf[p]
+                p += 1
+                pl += (b & 0x7F) * mult
+                mult <<= 7
+                k += 1
+                if not (b & 0x80):
+                    done = True
+                    break
+            if not done:
+                continue
+            if p + pl > e:
+                continue
+            pr_off, pr_len = p, pl
+            p += pl
+        topic_off[i] = t_off
+        topic_len[i] = tl
+        packet_id[i] = pid
+        props_off[i] = pr_off
+        props_len[i] = pr_len
+        payload_off[i] = p
+        payload_len[i] = e - p
+        flags[i] = b0 & 0x0F
+        kind[i] = 1
+    return out
 
 
 # ---------------------------------------------------------------------
@@ -302,8 +498,7 @@ def replayq_scan(data: bytes, max_items: int = 65536
             out.append((i + 4, n))
             i += 4 + n
         return out
-    arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
-        if data else (ctypes.c_uint8 * 1)()
+    arr = _buf_arg(data) if data else (ctypes.c_uint8 * 1)()
     off = (ctypes.c_uint32 * max_items)()
     lens = (ctypes.c_uint32 * max_items)()
     rc = lib.replayq_scan(arr, len(data), off, lens, max_items)

@@ -72,6 +72,94 @@ int mqtt_frame_scan(const uint8_t* buf, size_t len,
 }
 
 // ---------------------------------------------------------------------
+// Columnar PUBLISH decode (ISSUE 11): given the frame boundaries from
+// mqtt_frame_scan, decode every PUBLISH frame's wire fields into
+// parallel output arrays in one pass. Non-PUBLISH frames — and any
+// PUBLISH the strict parser must see for its precise error (qos 3,
+// truncated topic/packet-id, packet id 0, malformed property-length
+// varint, property span past the body) — stay kind=0 for the
+// per-packet parser. UTF-8 topic validation and v5 property CONTENT
+// parsing are the python side's job (it owns the string objects).
+// All offsets are absolute into buf; flags packs the fixed-header
+// nibble (bit0 retain, bits1-2 qos, bit3 dup). Outputs are written
+// only for kind=1 rows (kind=0 rows are all-zero), so the pure-python
+// fallback can be compared array-for-array. Returns the kind=1 count.
+// ---------------------------------------------------------------------
+int mqtt_publish_decode_columnar(
+        const uint8_t* buf, size_t len,
+        const uint32_t* off, const uint32_t* flen, int n, int v5,
+        uint8_t* kind, uint8_t* flags,
+        uint32_t* topic_off, uint32_t* topic_len, uint32_t* packet_id,
+        uint32_t* props_off, uint32_t* props_len,
+        uint32_t* payload_off, uint32_t* payload_len) {
+    int found = 0;
+    for (int i = 0; i < n; ++i) {
+        kind[i] = 0; flags[i] = 0;
+        topic_off[i] = 0; topic_len[i] = 0; packet_id[i] = 0;
+        props_off[i] = 0; props_len[i] = 0;
+        payload_off[i] = 0; payload_len[i] = 0;
+        size_t s = off[i];
+        size_t e = s + flen[i];
+        if (e > len || flen[i] < 2) continue;
+        uint8_t b0 = buf[s];
+        if ((b0 >> 4) != 3) continue;          // not PUBLISH
+        uint32_t qos = (b0 >> 1) & 0x3;
+        if (qos == 3) continue;                // strict: invalid_qos
+        // remaining-length varint (completeness proven by the scan;
+        // re-walked only to find the body start)
+        size_t p = s + 1;
+        int nb = 0;
+        while (p < e && nb < 4) {
+            uint8_t b = buf[p++];
+            ++nb;
+            if (!(b & 0x80)) break;
+        }
+        if (p + 2 > e) continue;               // truncated topic length
+        uint32_t tl = ((uint32_t)buf[p] << 8) | buf[p + 1];
+        p += 2;
+        if (p + tl > e) continue;              // truncated topic
+        size_t t_off = p;
+        p += tl;
+        uint32_t pid = 0;
+        if (qos > 0) {
+            if (p + 2 > e) continue;           // truncated packet id
+            pid = ((uint32_t)buf[p] << 8) | buf[p + 1];
+            p += 2;
+            if (pid == 0) continue;            // strict: packet id 0
+        }
+        size_t pr_off = 0, pr_len = 0;
+        if (v5) {
+            uint32_t pl = 0, mult = 1;
+            int k = 0;
+            bool done = false;
+            while (p < e && k < 4) {
+                uint8_t b = buf[p++];
+                pl += (uint32_t)(b & 0x7F) * mult;
+                mult <<= 7;
+                ++k;
+                if (!(b & 0x80)) { done = true; break; }
+            }
+            if (!done) continue;               // malformed props varint
+            if (p + pl > e) continue;          // props past body end
+            pr_off = p;
+            pr_len = pl;
+            p += pl;
+        }
+        topic_off[i] = (uint32_t)t_off;
+        topic_len[i] = tl;
+        packet_id[i] = pid;
+        props_off[i] = (uint32_t)pr_off;
+        props_len[i] = (uint32_t)pr_len;
+        payload_off[i] = (uint32_t)p;
+        payload_len[i] = (uint32_t)(e - p);
+        flags[i] = b0 & 0x0F;
+        kind[i] = 1;
+        ++found;
+    }
+    return found;
+}
+
+// ---------------------------------------------------------------------
 // Topic level hashing (FNV-1a 64) — the intern-table key function.
 // ---------------------------------------------------------------------
 static inline uint64_t fnv1a(const char* s, size_t n) {

@@ -1,0 +1,484 @@
+"""Columnar zero-copy PUBLISH ingress tests (ISSUE 11).
+
+Covers the whole layer: knob resolution, the parser's feed_columnar
+equivalence against the strict per-packet path, the differential fuzz
+corpus (columnar vs strict oracle — ZERO divergences; the same corpus
+runs under `make -C native test-asan`), the burst hand-off through a
+live broker over real TCP (A/B twin: delivery counts, per-publisher
+order and telemetry shape vs `columnar_ingress=0`), submit_burst
+semantics, the burst pre-encode's intern-version guard, and the
+SO_REUSEPORT acceptor lanes.
+"""
+
+import asyncio
+import os
+import random
+import subprocess
+
+import numpy as np
+import pytest
+
+from emqx_tpu import native
+from emqx_tpu.broker.connection import (Listener, resolve_columnar_ingress,
+                                        resolve_ingress_lanes)
+from emqx_tpu.broker.node import Node
+from emqx_tpu.client import Client
+from emqx_tpu.mqtt import constants as C
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import (FrameError, FrameParser, PublishBurst,
+                                 serialize)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# knob resolution
+# ---------------------------------------------------------------------
+class TestKnobs:
+    def test_columnar_default_on(self, monkeypatch):
+        monkeypatch.delenv("EMQX_TPU_COLUMNAR_INGRESS", raising=False)
+        assert resolve_columnar_ingress() is True
+
+    @pytest.mark.parametrize("val", ["0", "false", "off"])
+    def test_columnar_env_off(self, monkeypatch, val):
+        monkeypatch.setenv("EMQX_TPU_COLUMNAR_INGRESS", val)
+        assert resolve_columnar_ingress() is False
+
+    def test_columnar_config_beats_env(self, monkeypatch):
+        monkeypatch.setenv("EMQX_TPU_COLUMNAR_INGRESS", "0")
+        assert resolve_columnar_ingress(True) is True
+        monkeypatch.setenv("EMQX_TPU_COLUMNAR_INGRESS", "1")
+        assert resolve_columnar_ingress(False) is False
+
+    def test_lanes_default(self, monkeypatch):
+        monkeypatch.delenv("EMQX_TPU_INGRESS_LANES", raising=False)
+        assert resolve_ingress_lanes() == min(4, os.cpu_count() or 1)
+
+    def test_lanes_env_and_config(self, monkeypatch):
+        monkeypatch.setenv("EMQX_TPU_INGRESS_LANES", "2")
+        assert resolve_ingress_lanes() == 2
+        assert resolve_ingress_lanes(6) == 6   # config beats env
+
+    def test_lanes_malformed_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("EMQX_TPU_INGRESS_LANES", "two")
+        with pytest.raises(ValueError):
+            resolve_ingress_lanes()
+        with pytest.raises(ValueError):
+            resolve_ingress_lanes(0)
+
+    def test_columnar_off_forces_one_lane(self):
+        node = Node({"broker": {"columnar_ingress": False,
+                                "ingress_lanes": 4}})
+        assert node.columnar_ingress is False
+        assert node.ingress_lanes == 1
+
+
+# ---------------------------------------------------------------------
+# parser equivalence
+# ---------------------------------------------------------------------
+def _flatten(items):
+    """Columnar items -> the Packet list the per-packet path yields."""
+    out = []
+    for it in items:
+        if isinstance(it, PublishBurst):
+            for j in range(len(it)):
+                out.append(P.Publish(
+                    topic=it.topics[j], payload=it.payloads[j],
+                    qos=it.qos[j], retain=it.retain[j], dup=it.dup[j],
+                    packet_id=it.pids[j], properties=it.props[j]))
+        else:
+            out.append(it)
+    return out
+
+
+def _mixed_stream(rng, ver, n=400):
+    pkts = []
+    for _ in range(n):
+        k = rng.randrange(10)
+        if k < 6:
+            qos = rng.randrange(3)
+            props = {}
+            if ver == 5 and rng.randrange(3) == 0:
+                props = {"message_expiry_interval": rng.randrange(1000),
+                         "user_property": [("k", "v" * rng.randrange(5))]}
+            pkts.append(P.Publish(
+                topic=f"t/{rng.randrange(30)}/x",
+                payload=bytes(rng.randrange(200)), qos=qos,
+                retain=bool(rng.randrange(2)), dup=bool(qos and
+                                                        rng.randrange(2)),
+                packet_id=rng.randrange(1, 65535) if qos else None,
+                properties=props))
+        elif k == 6:
+            pkts.append(P.Pingreq())
+        elif k == 7:
+            pkts.append(P.Puback(packet_id=rng.randrange(1, 65535)))
+        elif k == 8:
+            pkts.append(P.Subscribe(packet_id=rng.randrange(1, 65535),
+                                    filters=[("a/+",
+                                              P.SubOpts(qos=1))]))
+        else:
+            pkts.append(P.Pubrel(packet_id=rng.randrange(1, 65535)))
+    return b"".join(serialize(p, ver) for p in pkts), pkts
+
+
+class TestFeedColumnar:
+    @pytest.mark.parametrize("ver", [4, 5])
+    def test_mixed_stream_equivalence(self, ver):
+        rng = random.Random(11 + ver)
+        stream, _src = _mixed_stream(rng, ver)
+        a = FrameParser(version=ver).feed(stream)
+        cols = FrameParser(version=ver)
+        b = _flatten(cols.feed_columnar(stream))
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert type(x) is type(y)
+            if isinstance(x, P.Publish):
+                assert (x.topic, bytes(x.payload), x.qos, x.retain,
+                        x.dup, x.packet_id, x.properties or {}) == \
+                       (y.topic, bytes(y.payload), y.qos, y.retain,
+                        y.dup, y.packet_id, y.properties or {})
+            else:
+                assert x == y
+        assert cols.pending_bytes == 0
+
+    @pytest.mark.parametrize("ver", [4, 5])
+    def test_chunked_equivalence(self, ver):
+        """Frames split across arbitrary read boundaries: the columnar
+        path buffers partial frames exactly like the per-packet path."""
+        rng = random.Random(23 + ver)
+        stream, _ = _mixed_stream(rng, ver, n=250)
+        a_parser = FrameParser(version=ver)
+        b_parser = FrameParser(version=ver)
+        a, b = [], []
+        pos = 0
+        while pos < len(stream):
+            step = rng.choice([1, 7, 100, 1500, 5000, 9000])
+            chunk = stream[pos:pos + step]
+            pos += step
+            a.extend(a_parser.feed(chunk))
+            b.extend(_flatten(b_parser.feed_columnar(chunk)))
+        assert len(a) == len(b)
+        assert a_parser.pending_bytes == b_parser.pending_bytes
+        for x, y in zip(a, b):
+            if isinstance(x, P.Publish):
+                assert (x.topic, bytes(x.payload), x.qos,
+                        x.packet_id) == (y.topic, bytes(y.payload),
+                                         y.qos, y.packet_id)
+            else:
+                assert x == y
+
+    def test_small_reads_stay_per_packet(self):
+        p = FrameParser(version=4)
+        items = p.feed_columnar(serialize(
+            P.Publish(topic="a", payload=b"b", qos=0), 4))
+        assert len(items) == 1 and isinstance(items[0], P.Publish)
+
+    def test_unknown_version_stays_per_packet(self):
+        """Pre-CONNECT bytes must parse after CONNECT fixes the
+        version — the columnar decode never runs at version=None."""
+        p = FrameParser()   # server-side fresh connection
+        conn = serialize(P.Connect(proto_name="MQTT", proto_ver=4,
+                                   clientid="c"), 4)
+        blob = conn + b"".join(
+            serialize(P.Publish(topic=f"t/{i}", payload=b"x" * 100,
+                                qos=0), 4) for i in range(200))
+        items = p.feed_columnar(blob)
+        assert isinstance(items[0], P.Connect)
+        assert sum(1 for it in items
+                   if isinstance(it, P.Publish)) == 200
+
+
+# ---------------------------------------------------------------------
+# differential fuzz: columnar vs strict parser as oracle
+# ---------------------------------------------------------------------
+def _mutate(rng, stream: bytes) -> bytes:
+    kind = rng.randrange(7)
+    b = bytearray(stream)
+    if not b:
+        return stream
+    if kind == 0:      # truncate mid-frame
+        return bytes(b[:rng.randrange(len(b))])
+    if kind == 1:      # flip random bytes
+        for _ in range(rng.randrange(1, 6)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+        return bytes(b)
+    if kind == 2:      # unterminated varint (malformed)
+        return bytes(b) + bytes([0x30, 0x80, 0x80, 0x80, 0x80, 0x01])
+    if kind == 3:      # qos=3 PUBLISH (strict: invalid_qos)
+        return bytes([0x36, 0x04, 0x00, 0x01, 0x61, 0x70]) + bytes(b)
+    if kind == 4:      # packet id 0 on a qos1 PUBLISH
+        return bytes([0x32, 0x05, 0x00, 0x01, 0x61, 0x00, 0x00]) \
+            + bytes(b)
+    if kind == 5:      # non-utf8 topic bytes
+        return bytes([0x30, 0x04, 0x00, 0x02, 0xC3, 0x28]) + bytes(b)
+    # truncated topic length past the body
+    return bytes([0x30, 0x02, 0x00, 0x63]) + bytes(b)
+
+
+def _drive(parser_kind: str, ver: int, chunks) -> tuple:
+    """Feed chunks; return (normalized packets, error code or None,
+    pending bytes) — the differential oracle's observable state."""
+    p = FrameParser(version=ver)
+    out = []
+    err = None
+    for chunk in chunks:
+        try:
+            if parser_kind == "columnar":
+                items = _flatten(p.feed_columnar(chunk))
+            else:
+                items = p.feed(chunk)
+        except FrameError as e:
+            err = e.code
+            break
+        for pkt in items:
+            if isinstance(pkt, P.Publish):
+                out.append(("pub", pkt.topic, bytes(pkt.payload),
+                            pkt.qos, pkt.retain, pkt.dup,
+                            pkt.packet_id, repr(pkt.properties or {})))
+            else:
+                out.append(repr(pkt))
+    return out, err, (p.pending_bytes if err is None else -1)
+
+
+def fuzz_corpus(n_streams: int = 120):
+    """The seeded corpus (also run under the Makefile asan target):
+    valid mixed streams + mutations (truncated varints, bad props,
+    qos2 flows, split-across-reads, flag/byte flips, max-frame
+    overflows), each fed at several chunkings."""
+    rng = random.Random(1299709)
+    for si in range(n_streams):
+        ver = 5 if si % 2 else 4
+        stream, _ = _mixed_stream(rng, ver, n=rng.randrange(40, 200))
+        if si % 3:
+            stream = _mutate(rng, stream)
+        if si % 7 == 0:   # qos2 flow: PUBLISH qos2 + PUBREL mixed
+            stream = serialize(P.Publish(topic="q2/a", payload=b"z",
+                                         qos=2, packet_id=9), ver) \
+                + serialize(P.Pubrel(packet_id=9), ver) + stream
+        # several chunkings per stream, including split-across-reads
+        chunkings = [[stream]]
+        for _ in range(2):
+            chunks, pos = [], 0
+            while pos < len(stream):
+                step = rng.choice([1, 3, 50, 1024, 4096, 8192])
+                chunks.append(stream[pos:pos + step])
+                pos += step
+            chunkings.append(chunks)
+        for chunks in chunkings:
+            yield ver, chunks
+
+
+class TestDifferentialFuzz:
+    def test_zero_divergences(self):
+        n = 0
+        for ver, chunks in fuzz_corpus():
+            a = _drive("strict", ver, chunks)
+            b = _drive("columnar", ver, chunks)
+            assert a == b, (
+                f"divergence on stream #{n} (ver {ver}): "
+                f"strict={a[1:]}, columnar={b[1:]}")
+            n += 1
+        assert n > 300
+
+    @pytest.mark.skipif(not native.available(),
+                        reason="native lib not built")
+    def test_native_vs_python_decode_bit_identical(self):
+        """The pure-python fallback mirrors the C decoder array for
+        array over the fuzz corpus (the repo's fallback-parity
+        pattern)."""
+        rng = random.Random(7)
+        for si in range(60):
+            ver = 5 if si % 2 else 4
+            stream, _ = _mixed_stream(rng, ver, n=60)
+            if si % 3:
+                stream = _mutate(rng, stream)
+            try:
+                off, lens, _cons = native.frame_scan_np(stream)
+            except native.FrameScanError:
+                continue
+            a = native.publish_decode_columnar(stream, off, lens,
+                                               ver == 5)
+            b = {k: np.zeros_like(v) for k, v in a.items()}
+            native._publish_decode_columnar_py(stream, off, lens,
+                                               ver == 5, b)
+            for k in a:
+                assert (a[k] == b[k]).all(), (si, k)
+
+
+# ---------------------------------------------------------------------
+# live broker A/B twin over real TCP
+# ---------------------------------------------------------------------
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def run(loop, coro, timeout=60):
+    return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+
+
+async def _drive_broker(columnar: bool, lanes: int = 1) -> dict:
+    node = Node({"broker": {"columnar_ingress": columnar,
+                            "ingress_lanes": lanes}})
+    lst = Listener(node, bind="127.0.0.1", port=0)
+    await lst.start()
+    sub = Client(port=lst.port, clientid="sub")
+    await sub.connect()
+    await sub.subscribe("t/#", qos=1)
+    pub = Client(port=lst.port, clientid="pub")
+    await pub.connect()
+    # one big write: interleaved qos0 (bulk) — large enough that the
+    # columnar node takes the burst path
+    blob = bytearray()
+    for i in range(1500):
+        blob += serialize(P.Publish(topic=f"t/{i % 8}",
+                                    payload=b"%06d" % i, qos=0), 4)
+    pub._writer.write(bytes(blob))
+    await pub._writer.drain()
+    acks = []
+    for i in range(30):
+        n = await pub.publish(f"t/q1/{i % 4}", b"%06d" % i, qos=1)
+        acks.append(n)
+    got = []
+    while len(got) < 1530:
+        m = await asyncio.wait_for(sub.messages.get(), 15)
+        got.append((m.topic, bytes(m.payload)))
+    snap = node.pipeline_telemetry.snapshot()
+    res = {
+        "got": got,
+        "acks": acks,
+        "publish": node.metrics.val("messages.publish"),
+        "recv": node.metrics.val("packets.publish.received"),
+        "snapshot_sections": sorted(snap.keys()),
+        "ingress": snap.get("ingress"),
+        "lane_accepted": sum(
+            v for k, v in node.metrics.all().items()
+            if k.startswith("pipeline.ingress.lane")),
+        "lane_servers": len(lst._lane_servers),
+    }
+    await pub.close()
+    await sub.close()
+    await lst.stop()
+    if node.publish_batcher is not None:
+        await node.publish_batcher.stop()
+    return res
+
+
+class TestBurstTwin:
+    def test_ab_identical_delivery_and_shape(self, loop):
+        """EMQX_TPU_COLUMNAR_INGRESS=0 restores the per-packet path
+        exactly: identical packets received, delivery counts,
+        per-publisher order — and the telemetry snapshot has no
+        `ingress` section."""
+        on = run(loop, _drive_broker(True), 120)
+        off = run(loop, _drive_broker(False), 120)
+        assert on["got"] == off["got"]           # order + payload twin
+        assert on["acks"] == off["acks"]         # qos1 counts twin
+        assert on["publish"] == off["publish"]
+        assert on["recv"] == off["recv"]
+        # per-publisher order: qos0 payload seq monotone
+        seqs = [p for t, p in on["got"] if not t.startswith("t/q1")]
+        assert seqs == sorted(seqs)
+        assert on["ingress"] is not None
+        assert on["ingress"]["rows"] >= 1500
+        assert "ingress" not in off["snapshot_sections"]
+
+    def test_acceptor_lanes(self, loop):
+        res = run(loop, _drive_broker(True, lanes=2), 120)
+        assert res["lane_servers"] == 2
+        assert res["lane_accepted"] == 2         # sub + pub conns
+        res_off = run(loop, _drive_broker(False, lanes=2), 120)
+        assert res_off["lane_servers"] == 0      # single accept loop
+
+
+class TestSubmitBurst:
+    def test_order_futures_and_backpressure(self, loop):
+        from emqx_tpu.broker.message import make
+        node = Node()
+        bt = node.publish_batcher
+        bt.max_pending = 8
+
+        async def go():
+            rows = [(make("p", i % 2, f"sb/{i}", b"%d" % i), i % 2 == 1)
+                    for i in range(12)]
+            futs = bt.submit_burst(rows)
+            # every qos1 row has a future; the last row is futured too
+            # (backpressure bound crossed)
+            assert set(futs) >= {i for i in range(12) if i % 2}
+            assert 11 in futs
+            assert [m.topic for m, _f in bt._queue] == \
+                [f"sb/{i}" for i in range(12)]
+            for f in futs.values():
+                assert (await f) == 0   # no subscribers
+        run(loop, go())
+        loop.run_until_complete(bt.stop())
+
+    def test_preencode_intern_version_guard(self):
+        """A filter word interned between the burst pre-encode and the
+        window encode invalidates the memo — the window re-encodes, so
+        encodings are bit-identical to the unmemoized path."""
+        node = Node()
+        eng = node.device_engine
+        eng.rebuild()
+        topics = ["pe/a/b", "pe/c"]
+        eng.preencode_burst(topics)
+        assert eng._burst_enc is not None
+        memo_hit = eng._encode_publish_batch(topics)
+        from emqx_tpu.ops.match import encode_topics_str
+        fresh = encode_topics_str(eng.intern, topics, eng.max_levels)
+        for a, b in zip(memo_hit, fresh):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        # intern a new word: the guard must drop the memo
+        eng.intern.intern("pe-new-word")
+        stale_guarded = eng._encode_publish_batch(topics)
+        fresh2 = encode_topics_str(eng.intern, topics, eng.max_levels)
+        for a, b in zip(stale_guarded, fresh2):
+            assert (np.asarray(a) == np.asarray(b)).all()
+
+
+# ---------------------------------------------------------------------
+# native-lib tier-1 gate (satellite): a build break must FAIL, not
+# silently demote every native test to the python fallback
+# ---------------------------------------------------------------------
+class TestNativeGate:
+    def test_native_lib_builds_and_loads(self):
+        if os.environ.get("EMQX_NATIVE_LIB"):
+            assert native.available(), \
+                "EMQX_NATIVE_LIB is set but did not load"
+            return
+        lib = os.path.join(REPO, "native", "libemqx_native.so")
+        if not os.path.exists(lib):
+            subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                           check=True, capture_output=True, timeout=120)
+        assert os.path.exists(lib), \
+            "native build produced no libemqx_native.so"
+        assert native.available(), (
+            "libemqx_native.so exists but failed to load — every "
+            "native test would silently run the python fallback")
+
+    @pytest.mark.slow
+    def test_make_asan_smoke(self):
+        """`make -C native asan` builds and the sanitized lib loads in
+        a clean subprocess (LD_PRELOADed ASAN runtime)."""
+        ndir = os.path.join(REPO, "native")
+        subprocess.run(["make", "-C", ndir, "asan"], check=True,
+                       capture_output=True, timeout=180)
+        cxx = os.environ.get("CXX", "g++")
+        asan_rt = subprocess.run(
+            [cxx, "-print-file-name=libasan.so"],
+            capture_output=True, text=True).stdout.strip()
+        env = dict(os.environ,
+                   EMQX_NATIVE_LIB=os.path.join(
+                       ndir, "libemqx_native_asan.so"),
+                   LD_PRELOAD=asan_rt,
+                   ASAN_OPTIONS="detect_leaks=0")
+        sp = subprocess.run(
+            [os.sys.executable if hasattr(os, "sys") else "python",
+             "-c",
+             "import emqx_tpu.native as n; assert n.available()"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=120)
+        assert sp.returncode == 0, sp.stderr[-500:]

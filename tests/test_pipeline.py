@@ -440,6 +440,15 @@ class TestWindowFusion:
         async def go():
             await asyncio.gather(*[
                 node.publish_async(mkmsg(f"fb/w{i}")) for i in range(8)])
+            # wait out the background class warm: a flood that drains
+            # before the (1, B8) class compiles routes host via
+            # cold_class and never reaches the dispatch under test
+            # (the ISSUE-11 hook-fold fast path made host routing fast
+            # enough to expose exactly that race)
+            for _ in range(600):
+                if node.device_engine.batch_class_warm(8):
+                    break
+                await asyncio.sleep(0.01)
 
             def boom(h):
                 raise RuntimeError("relay died")
