@@ -1,0 +1,51 @@
+# emqx_tpu — repo-level targets. (native/ has its own Makefile for the
+# C codec; this one is the operator/CI surface.)
+
+PY ?= python
+REPO := $(dir $(abspath $(lastword $(MAKEFILE_LIST))))
+
+.PHONY: help analyze analyze-changed test test-fast native
+
+help:
+	@echo "targets:"
+	@echo "  analyze          run the pipeline contract analyzer"
+	@echo "                   (tools/analysis, all 6 passes over"
+	@echo "                   emqx_tpu/ — docs/ANALYSIS.md; exit 1"
+	@echo "                   on findings)"
+	@echo "  analyze-changed  same framework, report filtered to"
+	@echo "                   files changed vs HEAD (the fast path:"
+	@echo "                   analysis still sees the whole repo, so"
+	@echo "                   cross-file passes stay sound)"
+	@echo "  test             tier-1 test suite (pytest -m 'not slow')"
+	@echo "  test-fast        analyzer + frame/topic unit slices only"
+	@echo "  native           build the native codec (native/)"
+
+analyze:
+	PYTHONPATH=$(REPO)tools $(PY) -m analysis --root $(REPO)
+
+# changed-files fast path: full-repo analysis (cheap — seconds), report
+# narrowed to your diff so pre-existing annotated context stays quiet
+analyze-changed:
+	@changed=$$( (git -C $(REPO) diff --name-only HEAD -- \
+	    'emqx_tpu/*.py' 'emqx_tpu/**/*.py' 'docs/*.md'; \
+	    git -C $(REPO) ls-files --others --exclude-standard -- \
+	    'emqx_tpu/*.py' 'emqx_tpu/**/*.py' 'docs/*.md') | sort -u); \
+	if [ -z "$$changed" ]; then \
+	    echo "analyze-changed: no changed emqx_tpu/docs files"; \
+	else \
+	    PYTHONPATH=$(REPO)tools $(PY) -m analysis --root $(REPO) \
+	        $$changed; \
+	fi
+
+test:
+	cd $(REPO) && JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	    -m 'not slow' --continue-on-collection-errors \
+	    -p no:cacheprovider
+
+test-fast:
+	cd $(REPO) && JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_analysis.py tests/test_frame.py \
+	    tests/test_topic.py -q -p no:cacheprovider
+
+native:
+	$(MAKE) -C $(REPO)native

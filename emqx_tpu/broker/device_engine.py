@@ -99,23 +99,57 @@ _PACKED_KEYS = {"qos", "nl", "rap", "rh"}
 #   EMQX_TPU_MATCH_CACHE=N  cross-batch match-cache capacity in unique
 #                           topics; 0 disables the cache layer only
 #                           (in-window dedup still engages)
-_ENV_DEDUP = os.environ.get("EMQX_TPU_DEDUP", "1") \
-    not in ("0", "false", "off")
-_ENV_CACHE = os.environ.get("EMQX_TPU_MATCH_CACHE")
-#   EMQX_TPU_COMPACT_READBACK=0 disables the CSR readback compaction
-#   (ISSUE 3): materialize transfers the full padded result planes
-#   instead of offsets + actual entries (the A/B knob the acceptance
-#   criteria compare; config key broker.compact_readback beats the env)
-_ENV_COMPACT = os.environ.get("EMQX_TPU_COMPACT_READBACK", "1") \
-    not in ("0", "false", "off")
-#   EMQX_TPU_DELTA_OVERLAY=0 disables the device-resident delta overlay
-#   (ISSUE 4): post-snapshot filters fall back to the pre-overlay
-#   behavior — host-trie match + host dispatch until the next full
-#   rebuild, with full O(N) recaptures at the rebuild threshold (the
-#   A/B knob the churn acceptance criteria compare; config key
-#   broker.delta_overlay beats the env)
-_ENV_DELTA = os.environ.get("EMQX_TPU_DELTA_OVERLAY", "1") \
-    not in ("0", "false", "off")
+def resolve_dedup(configured=None) -> bool:
+    """The one dedup-knob resolution: config (``broker.topic_dedup``)
+    beats ``EMQX_TPU_DEDUP`` beats default-on. ``=0`` disables
+    in-window unique-topic dedup AND the cached dispatch variant that
+    rides on it — the ISSUE-2 A/B baseline."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_DEDUP", "1") \
+        not in ("0", "false", "off")
+
+
+def resolve_match_cache_size(configured=None) -> int:
+    """The one match-cache-capacity resolution: config
+    (``broker.match_cache_size``) beats ``EMQX_TPU_MATCH_CACHE`` beats
+    the built-in ``DEFAULT_CAPACITY``. 0 disables the cache layer only
+    (in-window dedup still engages)."""
+    if configured is not None:
+        return int(configured)
+    env = os.environ.get("EMQX_TPU_MATCH_CACHE")
+    return int(env) if env is not None else DEFAULT_CAPACITY
+
+
+def resolve_compact_readback(configured=None) -> bool:
+    """The one compact-readback resolution: config
+    (``broker.compact_readback``) beats ``EMQX_TPU_COMPACT_READBACK``
+    beats default-on. ``=0`` restores dense-plane readback exactly —
+    the ISSUE-3 A/B baseline the acceptance criteria compare."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_COMPACT_READBACK", "1") \
+        not in ("0", "false", "off")
+
+
+def resolve_delta_overlay(configured=None) -> bool:
+    """The one delta-overlay resolution: config
+    (``broker.delta_overlay``) beats ``EMQX_TPU_DELTA_OVERLAY`` beats
+    default-on. ``=0`` restores host-trie fallback + full O(N)
+    recaptures at the rebuild threshold exactly — the ISSUE-4 churn
+    A/B baseline."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_DELTA_OVERLAY", "1") \
+        not in ("0", "false", "off")
+
+
+# module-level one-shot resolutions: engines read these when their
+# config leaves a knob unset (tests monkeypatch them directly, and
+# parallel/serving.py imports the compact/delta pair for the mesh)
+_ENV_DEDUP = resolve_dedup()
+_ENV_COMPACT = resolve_compact_readback()
+_ENV_DELTA = resolve_delta_overlay()
 
 
 def resolve_rebuild_threshold(configured=None) -> int:
@@ -557,8 +591,7 @@ class DeviceRouteEngine:
         if dedup is None:
             dedup = _ENV_DEDUP
         if match_cache_size is None:
-            match_cache_size = int(_ENV_CACHE) if _ENV_CACHE is not None \
-                else DEFAULT_CAPACITY
+            match_cache_size = resolve_match_cache_size()
         self.dedup = bool(dedup)
         self._match_cache: Optional[MatchCache] = \
             MatchCache(match_cache_size, node.metrics) \

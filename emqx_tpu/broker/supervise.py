@@ -185,6 +185,17 @@ def parse_faults(spec: Optional[str]) -> list[_Fault]:
     return out
 
 
+def resolve_faults(configured=None) -> list:
+    """The one fault-spec resolution: an explicit clause list beats the
+    ``EMQX_TPU_FAULTS`` env spec beats none. Deliberately has NO config
+    key — fault injection is a per-process chaos knob (chaos_bench,
+    tier-1 chaos cells), never cluster configuration; a malformed spec
+    raises at parse so a typo'd chaos run fails loudly."""
+    if configured is not None:
+        return configured
+    return parse_faults(os.environ.get("EMQX_TPU_FAULTS"))
+
+
 class FaultInjector:
     """Deterministic injection-point registry. ``fire(point)`` is the
     stage-boundary check: raises (exception/resource), sleeps (hang) or
@@ -193,8 +204,7 @@ class FaultInjector:
     executor threads."""
 
     def __init__(self, faults: Optional[list[_Fault]] = None):
-        self.faults = faults if faults is not None \
-            else parse_faults(os.environ.get("EMQX_TPU_FAULTS"))
+        self.faults = resolve_faults(faults)
         self._lock = threading.Lock()
 
     def armed(self) -> bool:
@@ -219,6 +229,10 @@ class FaultInjector:
         if action is None:
             return None
         if action.kind == "hang":
+            # analysis: ok(loop-affinity) — the hang IS the injected
+            # fault: a chaos clause emulating a wedged stage/link must
+            # block exactly where the real wedge would (loop-side
+            # points included); never armed outside chaos runs
             time.sleep(action.hang_s)
             return None
         if action.kind == "resource":
@@ -325,9 +339,53 @@ class CircuitBreaker:
 # absorbs cold histograms and scheduling jitter; the cap bounds how long
 # a wedged stage can hold a pipeline slot even when the p99 history is
 # already pathological.
-_WD_FLOOR_S = float(os.environ.get("EMQX_TPU_WATCHDOG_FLOOR_S", "10"))
-_WD_CAP_S = float(os.environ.get("EMQX_TPU_WATCHDOG_CAP_S", "120"))
-_WD_MULT = float(os.environ.get("EMQX_TPU_WATCHDOG_MULT", "8"))
+
+
+def resolve_watchdog_floor_s(configured=None) -> float:
+    """Watchdog deadline floor: an explicit supervisor kwarg beats
+    ``EMQX_TPU_WATCHDOG_FLOOR_S`` beats 10s."""
+    if configured is not None:
+        return float(configured)
+    return float(os.environ.get("EMQX_TPU_WATCHDOG_FLOOR_S", "10"))
+
+
+def resolve_watchdog_cap_s(configured=None) -> float:
+    """Watchdog deadline cap: an explicit supervisor kwarg beats
+    ``EMQX_TPU_WATCHDOG_CAP_S`` beats 120s."""
+    if configured is not None:
+        return float(configured)
+    return float(os.environ.get("EMQX_TPU_WATCHDOG_CAP_S", "120"))
+
+
+def resolve_watchdog_mult(configured=None) -> float:
+    """Watchdog p99 multiplier: an explicit supervisor kwarg beats
+    ``EMQX_TPU_WATCHDOG_MULT`` beats 8."""
+    if configured is not None:
+        return float(configured)
+    return float(os.environ.get("EMQX_TPU_WATCHDOG_MULT", "8"))
+
+
+_WD_FLOOR_S = resolve_watchdog_floor_s()
+_WD_CAP_S = resolve_watchdog_cap_s()
+_WD_MULT = resolve_watchdog_mult()
+
+
+def resolve_breaker_threshold(configured=None) -> int:
+    """Consecutive faults before a stage breaker opens: config
+    (``broker.supervise_threshold``, passed down by the node) beats
+    ``EMQX_TPU_BREAKER_THRESHOLD`` beats 3."""
+    if configured is not None:
+        return int(configured)
+    return int(os.environ.get("EMQX_TPU_BREAKER_THRESHOLD", "3"))
+
+
+def resolve_breaker_cooldown_s(configured=None) -> float:
+    """Half-open probe base cooldown: an explicit supervisor kwarg
+    beats ``EMQX_TPU_BREAKER_COOLDOWN_S`` beats 1s (exponential up to
+    the breaker's 30s max)."""
+    if configured is not None:
+        return float(configured)
+    return float(os.environ.get("EMQX_TPU_BREAKER_COOLDOWN_S", "1.0"))
 
 # process-wide count of guarded-task deaths, for contexts without a
 # Metrics registry (and for tests asserting the guard fired at all)
@@ -448,12 +506,8 @@ class PipelineSupervisor:
         self.telemetry = telemetry
         self.injector = injector if injector is not None else \
             FaultInjector()
-        if threshold is None:
-            threshold = int(os.environ.get(
-                "EMQX_TPU_BREAKER_THRESHOLD", "3"))
-        if cooldown_s is None:
-            cooldown_s = float(os.environ.get(
-                "EMQX_TPU_BREAKER_COOLDOWN_S", "1.0"))
+        threshold = resolve_breaker_threshold(threshold)
+        cooldown_s = resolve_breaker_cooldown_s(cooldown_s)
         self.breakers: dict[str, CircuitBreaker] = {
             p: CircuitBreaker(p, threshold=threshold,
                               cooldown_s=cooldown_s)

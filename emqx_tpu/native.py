@@ -39,6 +39,10 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
         try:
+            # analysis: ok(loop-affinity) — one-shot bootstrap: builds
+            # the missing .so on the FIRST native call of the process
+            # (guarded by _tried), before any traffic is flowing; every
+            # later call takes the `_lib is not None` fast path above
             subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                            capture_output=True, timeout=120)
         except (subprocess.SubprocessError, OSError) as e:

@@ -71,6 +71,9 @@ class InternTable:
         with the table (retirement is an allocation-failure path, so the
         parked set stays tiny)."""
         self._retired.append(h)
+        # analysis: ok(cross-thread-state) — every caller holds
+        # self._lock around this call (see the three call sites); the
+        # guard is dynamic, not lexical, so the analyzer can't see it
         self._mirror = False
 
     def _attach_mirror(self) -> "int | bool":

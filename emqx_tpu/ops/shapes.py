@@ -438,10 +438,22 @@ def _probe_buckets(st: ShapeTables, h1, h2, b1, b2,
 # match_pallas_per_s beats match_xla_per_s on the target hardware.
 import os as _os
 
-_FOLD_BACKEND = _os.environ.get("EMQX_TPU_FOLD", "xla")
-if _FOLD_BACKEND not in ("xla", "pallas"):
-    raise ValueError(
-        f"EMQX_TPU_FOLD={_FOLD_BACKEND!r}: expected 'xla' or 'pallas'")
+
+def resolve_fold_backend(configured=None) -> str:
+    """The one fold-backend resolution: an explicit value (callers use
+    ``set_fold_backend``) beats ``EMQX_TPU_FOLD`` beats ``"xla"``.
+    Import-time knob — config cannot reach module import, so the env is
+    the deploy-time override; validated so a typo fails loudly instead
+    of silently serving the default backend."""
+    backend = configured if configured is not None \
+        else _os.environ.get("EMQX_TPU_FOLD", "xla")
+    if backend not in ("xla", "pallas"):
+        raise ValueError(
+            f"EMQX_TPU_FOLD={backend!r}: expected 'xla' or 'pallas'")
+    return backend
+
+
+_FOLD_BACKEND = resolve_fold_backend()
 
 
 # False when the last backend switch could not clear shape_match's jit

@@ -62,15 +62,29 @@ class SharedPickResult(NamedTuple):
 # rank/occur stage cost directly)
 import os as _os
 
-try:
-    _RANK_BLOCK = int(_os.environ.get("EMQX_TPU_RANK_BLOCK", 512))
-except ValueError as _e:
-    raise ValueError(
-        f"EMQX_TPU_RANK_BLOCK must be an integer, got "
-        f"{_os.environ['EMQX_TPU_RANK_BLOCK']!r}") from _e
-if _RANK_BLOCK < 8:
-    raise ValueError(
-        f"EMQX_TPU_RANK_BLOCK must be >= 8, got {_RANK_BLOCK}")
+
+def resolve_rank_block(configured=None) -> int:
+    """The one rank-block resolution: an explicit width (callers use
+    ``set_rank_block``) beats ``EMQX_TPU_RANK_BLOCK`` beats 512.
+    Import-time knob — config cannot reach module import, so the env is
+    the deploy-time sweep handle; must be an integer >= 8 (a narrower
+    block degenerates the in-block compare), anything else fails
+    loudly."""
+    raw = configured if configured is not None \
+        else _os.environ.get("EMQX_TPU_RANK_BLOCK", 512)
+    try:
+        block = int(raw)
+    except (TypeError, ValueError) as _e:
+        raise ValueError(
+            f"EMQX_TPU_RANK_BLOCK must be an integer, got "
+            f"{raw!r}") from _e
+    if block < 8:
+        raise ValueError(
+            f"EMQX_TPU_RANK_BLOCK must be >= 8, got {block}")
+    return block
+
+
+_RANK_BLOCK = resolve_rank_block()
 
 
 def set_rank_block(width: int) -> None:

@@ -414,6 +414,9 @@ class ShardedRouteServer:
         except Exception:
             # a failed build must not eat the churn marks: the old
             # snapshot keeps serving and those shards still need repair
+            # analysis: ok(cross-thread-state) — set |= set is ONE
+            # C-level update under the GIL; idempotent re-mark (same
+            # mark-restore discipline as the async capture path)
             self.dirty_shards |= seen
             raise
 
@@ -487,6 +490,10 @@ class ShardedRouteServer:
             return
         gen = self._next_gen()
         seen = set(self.dirty_shards)
+        # analysis: ok(cross-thread-state) — set -= set is ONE C-level
+        # difference_update under the GIL; removing exactly `seen`
+        # keeps any mark the build thread adds concurrently (the
+        # mark-restore discipline the gen checks below complete)
         self.dirty_shards -= seen
         try:
             loop = asyncio.get_running_loop()
@@ -518,6 +525,9 @@ class ShardedRouteServer:
             import logging
             logging.getLogger("emqx_tpu.serving").exception(
                 "chunked mesh capture failed; backing off")
+            # analysis: ok(cross-thread-state) — set |= set is ONE
+            # C-level update under the GIL; re-marking is idempotent
+            # against the build thread's concurrent |=
             self.dirty_shards |= seen
             self._rebuild_backoff_until = time.monotonic() + 5.0
             return
@@ -525,6 +535,8 @@ class ShardedRouteServer:
             # superseded by a newer capture/rebuild: drop the captures,
             # but RESTORE the marks — if the superseding build failed,
             # these shards' churn would otherwise be permanently lost
+            # analysis: ok(cross-thread-state) — set |= set is ONE
+            # C-level update under the GIL; idempotent re-mark
             self.dirty_shards |= seen
             return
         self._start_build_thread(captures, seen, gen)
@@ -538,12 +550,17 @@ class ShardedRouteServer:
                 logging.getLogger("emqx_tpu.serving").exception(
                     "background mesh rebuild failed; backing off")
                 self.node.metrics.inc("routing.mesh.rebuild_failed")
+                # analysis: ok(cross-thread-state) — set |= set is ONE
+                # C-level update under the GIL; the loop side's -= of
+                # its own snapshot can't lose this re-mark
                 self.dirty_shards |= seen
                 self._rebuild_backoff_until = time.monotonic() + 5.0
                 return
             if not self._adopt_full_build(result, gen):
                 # a newer build won the race; its capture covered this
                 # one's state, but conservatively re-mark the shards
+                # analysis: ok(cross-thread-state) — set |= set is ONE
+                # C-level update under the GIL; idempotent re-mark
                 self.dirty_shards |= seen
 
         self._rebuild_thread = threading.Thread(target=work, daemon=True)

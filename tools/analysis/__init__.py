@@ -1,0 +1,43 @@
+"""Pipeline contract analyzer (ISSUE 12): one static-analysis framework
+for the correctness contracts eleven PRs of review kept re-finding by
+hand.
+
+The repo's concurrency model is layered — event-loop coroutines, one
+ordered dispatch thread, a two-worker readback pool, warm/rebuild
+threads, delivery-lane tasks, jit-traced fused route programs — and
+each layer has contracts that a silent violation turns into a race, a
+wedge, or a twin-oracle divergence:
+
+- loop code must not block (``loop-affinity``);
+- state shared across threads must be lock-guarded where it is
+  read-modify-written (``cross-thread-state`` — the PR 7 ring-counter
+  race, machine-checked);
+- fused route programs must stay trace-pure (``jit-purity``);
+- every ``EMQX_TPU_*`` env knob must route through a
+  config-beats-env-beats-default ``resolve_*`` function, be documented,
+  and have a test reference (``knob-discipline``);
+- asyncio tasks must not be fire-and-forgotten and exception swallows
+  must explain themselves (``task-hygiene``, migrated from
+  tools/check_task_hygiene.py);
+- persistent ``device_put`` allocations must ride the HBM ledger
+  (``hbm-hygiene``, migrated from tools/check_hbm_hygiene.py).
+
+Shared infrastructure: an AST module loader over ``emqx_tpu/``
+(:mod:`analysis.core`), a call-graph/context engine classifying every
+function as loop / thread / jit reachable (:mod:`analysis.contexts`),
+the ``# analysis: ok(<pass>) — <reason>`` annotation grammar, and a
+findings report with stable IDs. Run ``python -m analysis --help``
+(with ``tools/`` on ``PYTHONPATH``) or ``make analyze``; the whole
+framework is also wired as tier-1 tests (tests/test_analysis.py).
+
+Docs: docs/ANALYSIS.md (pass catalog, the thread-affinity model, the
+annotation grammar, how to add a pass).
+"""
+
+from analysis.core import (  # noqa: F401
+    Finding,
+    Module,
+    Repo,
+    ALL_PASSES,
+    run_repo,
+)

@@ -1,0 +1,94 @@
+"""CLI: run the pipeline contract analyzer.
+
+    PYTHONPATH=tools python -m analysis [options] [PATH...]
+
+PATH arguments (repo-relative file paths) restrict the REPORT — the
+analysis itself always loads the whole package so cross-file passes
+(context propagation, knob discipline) stay sound on the changed-files
+fast path (`make analyze-changed`).
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error — the same
+contract the two legacy checker scripts had.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from analysis.core import PASS_NAMES, Repo, run_repo
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analysis",
+        description="pipeline contract analyzer (docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict the report to these repo-relative "
+                         "files (analysis still sees the whole repo)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto from this file)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help=f"run only this pass (repeatable); known: "
+                         f"{', '.join(PASS_NAMES)}")
+    ap.add_argument("--list", action="store_true",
+                    help="list passes and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print annotated-ok findings")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for n in PASS_NAMES:
+            print(n)
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.isdir(os.path.join(root, "emqx_tpu")):
+        print(f"analysis: no emqx_tpu/ package under {root!r}",
+              file=sys.stderr)
+        return 2
+
+    only = None
+    if args.paths:
+        only = []
+        for p in args.paths:
+            rel = os.path.relpath(os.path.abspath(p), root) \
+                if os.path.isabs(p) or os.path.exists(p) else p
+            only.append(rel.replace(os.sep, "/"))
+
+    try:
+        repo = Repo.from_fs(root)
+        findings, suppressed = run_repo(repo, passes=args.passes,
+                                        only=only)
+    except KeyError as e:
+        print(f"analysis: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "findings": [
+                {"id": f.fid, "pass": f.pass_name, "path": f.path,
+                 "line": f.line, "detail": f.detail}
+                for f in findings],
+            "suppressed": len(suppressed),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(repr(f))
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"suppressed: {f!r}")
+        print(f"{len(findings)} finding(s), {len(suppressed)} "
+              f"suppressed by annotation, over "
+              f"{len(repo.modules)} modules")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,374 @@
+"""Call-graph + execution-context engine.
+
+Classifies every function in the analyzed package by the context(s) it
+can execute in:
+
+- ``loop``   — the asyncio event loop. Seeds: every ``async def``, and
+  callbacks handed to ``call_soon``/``call_later``/``call_at``/
+  ``call_soon_threadsafe``/``add_done_callback`` (all run on the loop).
+- ``thread`` — an executor / raw thread. Seeds: ``Thread(target=f)``,
+  ``loop.run_in_executor(pool, f, ...)``, ``<executor>.submit(f, ...)``
+  (the batcher's dispatch thread and readback pool, the engine warm
+  threads, the mesh rebuild thread).
+- ``jit``    — traced inside a fused route program. Seeds: functions
+  decorated with ``jax.jit``/``pjit`` (the ``router_engine`` fused-
+  program registry binds exactly these), plus callables passed to
+  ``jit``/``pjit``/``vmap``/``pmap``/``shard_map`` call-forms.
+
+Contexts PROPAGATE along resolved call edges to a fixpoint: a sync
+helper called from a coroutine is loop-context; a helper called from
+``dispatch`` (which runs on the dispatch thread) is thread-context; an
+op called from a jitted program is jit-context. A function can hold
+several contexts at once — ``FlightRecorder.record`` is deliberately
+loop+thread, which is precisely why the cross-thread-state pass exists.
+
+Call resolution is name-based and deliberately over-approximate in one
+bounded way: an attribute call ``obj.m(...)`` whose receiver cannot be
+typed resolves to every method named ``m`` in the package, but only
+when ``m`` is distinctive (defined by at most ``DUCK_MAX`` classes and
+not on the common-name stoplist). Thread/loop seed extraction from
+``run_in_executor``/``Thread(target=...)``/``submit`` has no such cap —
+those hand-offs are explicit.
+
+Each propagated context keeps its predecessor, so a finding can print
+WHY the analyzer believes a function is loop- or thread-reachable
+(``chain_str``) instead of asserting it bare.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Optional
+
+from analysis.core import Module, Repo, dotted_name
+
+# attribute calls on untyped receivers resolve by method name only when
+# the name is defined by at most this many classes...
+DUCK_MAX = 6
+# ...and is not one of these (generic container/protocol names resolve
+# to half the package and would smear contexts everywhere)
+DUCK_STOP = frozenset((
+    "get", "put", "set", "add", "pop", "close", "open", "run", "send",
+    "write", "read", "append", "appendleft", "clear", "items", "keys",
+    "values", "update", "start", "stop", "wait", "cancel", "done",
+    "result", "copy", "encode", "decode", "inc", "observe", "join",
+    "flush", "reset", "next", "state", "snapshot", "section", "match",
+    "feed", "drain", "release", "acquire", "count", "name",
+    "send_packet", "lookup", "register", "info", "error", "warning",
+    "debug", "exception", "remove", "discard", "insert", "extend",
+    # NOT stoplisted though they look generic: "submit" (the delivery
+    # lane pool's loop-side entry — verify drives proved stoplisting
+    # it blinds loop-affinity to the whole lane submit path) and
+    # "record" (the flight recorder's loop+thread hot path — the very
+    # PR-7 surface the cross-thread pass exists for)
+))
+
+LOOP_CB_METHODS = frozenset((
+    "call_soon", "call_soon_threadsafe", "call_later", "call_at",
+    "add_done_callback",
+))
+JIT_WRAPPERS = frozenset(("jit", "pjit", "vmap", "pmap", "shard_map"))
+
+
+class FuncInfo:
+    __slots__ = ("mod", "node", "name", "qualname", "cls", "is_async",
+                 "parent", "contexts", "pred", "edges", "nested")
+
+    def __init__(self, mod: Module, node, qualname: str,
+                 cls: Optional[str], parent: Optional["FuncInfo"]):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.cls = cls
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.parent = parent
+        self.contexts: set[str] = set()
+        # ctx -> (reason, predecessor FuncInfo | None)
+        self.pred: dict[str, tuple[str, Optional["FuncInfo"]]] = {}
+        self.edges: list["FuncInfo"] = []
+        self.nested: dict[str, "FuncInfo"] = {}
+
+    def __repr__(self):
+        return f"<fn {self.mod.path}::{self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("mod", "node", "name", "bases", "methods")
+
+    def __init__(self, mod: Module, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.bases = [dotted_name(b) for b in node.bases]
+        self.methods: dict[str, FuncInfo] = {}
+
+
+def _body_walk(fn_node):
+    """Walk a function body WITHOUT descending into nested function /
+    class definitions (their calls run in their own context, and they
+    are their own FuncInfo nodes) — lambdas stay in, they execute
+    inline for our purposes."""
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _decorated_jit(node) -> bool:
+    for dec in node.decorator_list:
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Name) and sub.id in ("jit", "pjit"):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("jit", "pjit"):
+                return True
+    return False
+
+
+class ContextGraph:
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.functions: list[FuncInfo] = []
+        self.by_module: dict[str, list[FuncInfo]] = {}
+        self.classes: list[ClassInfo] = []
+        self._methods_by_name: dict[str, list[FuncInfo]] = {}
+        self._mod_funcs: dict[str, dict[str, FuncInfo]] = {}
+        self._mod_classes: dict[str, dict[str, ClassInfo]] = {}
+        self._imports: dict[str, dict[str, str]] = {}
+        self._from_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self._by_node: dict[int, FuncInfo] = {}
+        self._modname_to_path: dict[str, str] = {}
+        for mod in repo.modules.values():
+            self._modname_to_path[mod.modname] = mod.path
+        for mod in repo.modules.values():
+            if mod.tree is not None:
+                self._collect_module(mod)
+        self._resolve_edges_and_seeds()
+        self._propagate()
+
+    # ---- collection ------------------------------------------------------
+    def _collect_module(self, mod: Module) -> None:
+        self.by_module[mod.path] = []
+        self._mod_funcs[mod.path] = {}
+        self._mod_classes[mod.path] = {}
+        imports: dict[str, str] = {}
+        from_imports: dict[str, tuple[str, str]] = {}
+        self._imports[mod.path] = imports
+        self._from_imports[mod.path] = from_imports
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    from_imports[a.asname or a.name] = \
+                        (node.module, a.name)
+
+        def visit(body, cls: Optional[ClassInfo],
+                  parent: Optional[FuncInfo], prefix: str):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    fi = FuncInfo(mod, node, qual,
+                                  cls.name if cls else None, parent)
+                    self.functions.append(fi)
+                    self.by_module[mod.path].append(fi)
+                    self._by_node[id(node)] = fi
+                    if parent is not None:
+                        parent.nested[node.name] = fi
+                    elif cls is not None:
+                        cls.methods[node.name] = fi
+                        self._methods_by_name.setdefault(
+                            node.name, []).append(fi)
+                    else:
+                        self._mod_funcs[mod.path][node.name] = fi
+                    visit(node.body, cls if parent is None else cls,
+                          fi, qual + ".")
+                elif isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(mod, node)
+                    self.classes.append(ci)
+                    self._mod_classes[mod.path][node.name] = ci
+                    visit(node.body, ci, None, f"{node.name}.")
+                else:
+                    # functions defined under `if TYPE_CHECKING:` etc.
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef)):
+                            visit([child], cls, parent, prefix)
+
+        visit(mod.tree.body, None, None, "")
+
+    # ---- resolution ------------------------------------------------------
+    def _module_for(self, path: str, alias: str) -> Optional[str]:
+        """Map a name used in `path` to an analyzed module path, via
+        `import x.y as alias` / `from pkg import mod [as alias]`."""
+        full = self._imports.get(path, {}).get(alias)
+        if full is not None:
+            return self._modname_to_path.get(full)
+        fi = self._from_imports.get(path, {}).get(alias)
+        if fi is not None:
+            return self._modname_to_path.get(f"{fi[0]}.{fi[1]}")
+        return None
+
+    def resolve(self, expr, fi: FuncInfo) -> list[FuncInfo]:
+        """Resolve a callable expression to candidate FuncInfos."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            cur = fi
+            while cur is not None:
+                if n in cur.nested:
+                    return [cur.nested[n]]
+                cur = cur.parent
+            mf = self._mod_funcs.get(fi.mod.path, {}).get(n)
+            if mf is not None:
+                return [mf]
+            imp = self._from_imports.get(fi.mod.path, {}).get(n)
+            if imp is not None:
+                src_path = self._modname_to_path.get(imp[0])
+                if src_path is not None:
+                    tgt = self._mod_funcs.get(src_path, {}) \
+                        .get(imp[1])
+                    if tgt is not None:
+                        return [tgt]
+            return []
+        if isinstance(expr, ast.Attribute):
+            m = expr.attr
+            recv = expr.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls") \
+                    and fi.cls is not None:
+                ci = self._mod_classes.get(fi.mod.path, {}).get(fi.cls)
+                seen: set[str] = set()
+                while ci is not None:
+                    if m in ci.methods:
+                        return [ci.methods[m]]
+                    seen.add(ci.name)
+                    nxt = None
+                    for b in ci.bases:
+                        base = b.split(".")[-1]
+                        if base in seen:
+                            continue
+                        cand = self._mod_classes.get(
+                            ci.mod.path, {}).get(base)
+                        if cand is None:
+                            src = self._module_for(ci.mod.path,
+                                                   b.split(".")[0])
+                            if src is not None:
+                                cand = self._mod_classes.get(
+                                    src, {}).get(base)
+                        if cand is not None:
+                            nxt = cand
+                            break
+                    ci = nxt
+                return []
+            if isinstance(recv, ast.Name):
+                src = self._module_for(fi.mod.path, recv.id)
+                if src is not None:
+                    tgt = self._mod_funcs.get(src, {}).get(m)
+                    return [tgt] if tgt is not None else []
+            cands = self._methods_by_name.get(m, [])
+            if cands and len(cands) <= DUCK_MAX and m not in DUCK_STOP:
+                return list(cands)
+            return []
+        return []
+
+    # ---- seeds + edges ---------------------------------------------------
+    def _resolve_edges_and_seeds(self) -> None:
+        self._seeds: list[tuple[FuncInfo, str, str]] = []
+        for fi in self.functions:
+            if fi.is_async:
+                self._seeds.append((fi, "loop", "async def"))
+            if _decorated_jit(fi.node):
+                self._seeds.append((fi, "jit", "jit-decorated"))
+            for node in _body_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                where = f"{fi.mod.path}:{node.lineno}"
+                fn = node.func
+                fdot = dotted_name(fn)
+                fattr = fn.attr if isinstance(fn, ast.Attribute) \
+                    else fdot
+                # thread entries
+                if fattr in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            self._seed_arg(kw.value, fi, "thread",
+                                           f"Thread target at {where}")
+                elif fattr == "run_in_executor" and len(node.args) >= 2:
+                    self._seed_arg(node.args[1], fi, "thread",
+                                   f"run_in_executor at {where}")
+                elif fattr == "submit" and node.args:
+                    # seeds only when the arg resolves to a function —
+                    # `pool.submit(plan_obj)` (the delivery lanes' own
+                    # submit) resolves to nothing and seeds nothing
+                    self._seed_arg(node.args[0], fi, "thread",
+                                   f"executor submit at {where}")
+                elif fattr in LOOP_CB_METHODS and node.args:
+                    cb = node.args[1] if fattr in ("call_later",
+                                                   "call_at") \
+                        and len(node.args) >= 2 else node.args[0]
+                    self._seed_arg(cb, fi, "loop",
+                                   f"loop callback at {where}")
+                elif (fattr in JIT_WRAPPERS
+                      or (isinstance(fn, ast.Name)
+                          and fn.id in JIT_WRAPPERS)) and node.args:
+                    self._seed_arg(node.args[0], fi, "jit",
+                                   f"traced via {fattr} at {where}")
+                # ordinary call edge
+                fi.edges.extend(self.resolve(fn, fi))
+
+    def _seed_arg(self, expr, fi: FuncInfo, ctx: str,
+                  why: str) -> None:
+        for t in self.resolve(expr, fi):
+            self._seeds.append((t, ctx, why))
+
+    # ---- propagation -----------------------------------------------------
+    def _propagate(self) -> None:
+        for ctx in ("loop", "thread", "jit"):
+            q: deque[FuncInfo] = deque()
+            for fi, c, why in self._seeds:
+                if c != ctx or ctx in fi.contexts:
+                    continue
+                fi.contexts.add(ctx)
+                fi.pred[ctx] = (why, None)
+                q.append(fi)
+            while q:
+                fi = q.popleft()
+                for tgt in fi.edges:
+                    if ctx in tgt.contexts:
+                        continue
+                    # a thread (or a trace) cannot transparently enter
+                    # a coroutine — crossing back onto the loop takes
+                    # an explicit hand-off, which is its own seed
+                    if ctx in ("thread", "jit") and tgt.is_async:
+                        continue
+                    tgt.contexts.add(ctx)
+                    tgt.pred[ctx] = (f"called from {fi.qualname}", fi)
+                    q.append(tgt)
+
+    # ---- reporting helpers ----------------------------------------------
+    def func_for_node(self, node) -> Optional[FuncInfo]:
+        from analysis.core import parent_chain
+        if id(node) in self._by_node:
+            return self._by_node[id(node)]
+        for p in parent_chain(node):
+            if id(p) in self._by_node:
+                return self._by_node[id(p)]
+        return None
+
+    def chain_str(self, fi: FuncInfo, ctx: str, cap: int = 6) -> str:
+        """'f <- g <- seed(reason)': why fi holds ctx."""
+        hops: list[str] = []
+        cur: Optional[FuncInfo] = fi
+        why = ""
+        while cur is not None and len(hops) < cap:
+            hops.append(cur.qualname)
+            why, cur = cur.pred.get(ctx, ("", None))
+        return " <- ".join(hops) + (f" [{why}]" if why else "")

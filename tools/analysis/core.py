@@ -1,0 +1,345 @@
+"""Shared analyzer infrastructure: module loading, the annotation
+grammar, findings with stable IDs, and the pass runner.
+
+Everything here is pure and filesystem-optional: `Repo.from_sources`
+builds a whole analyzable "repository" out of in-memory strings, which
+is how the seeded-violation corpus in tests/test_analysis.py proves
+each pass catches its defect class without touching the real tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+# ---- the annotation grammar ---------------------------------------------
+#
+#   # analysis: ok(<pass>[, <pass>...]) — <reason>
+#
+# suppresses findings of the named pass(es) on the annotated statement
+# (the annotation may sit on any line of the statement, or on the line
+# directly above it). The reason is MANDATORY — a reason-less ok() is
+# indistinguishable from a drive-by silence and is itself reported as a
+# malformed annotation. Separator: em-dash, en-dash, "--" or "-".
+_ANNOT_RE = re.compile(
+    r"#\s*analysis:\s*ok\(\s*([^)]*?)\s*\)\s*(?:(?:—|–|--|-)\s*(\S.*))?")
+# anything that LOOKS like it wants to be an analysis annotation — used
+# to flag malformed variants that would otherwise silently not suppress
+_ANNOT_INTENT_RE = re.compile(r"#\s*analysis\s*:")
+
+PASS_NAMES = (
+    "loop-affinity",
+    "cross-thread-state",
+    "jit-purity",
+    "knob-discipline",
+    "task-hygiene",
+    "hbm-hygiene",
+)
+
+
+class Finding:
+    """One analyzer finding. The ID is stable across line drift: it
+    hashes (path, pass, anchor) where `anchor` names the defect site
+    structurally (qualname + symbol), never by line number."""
+
+    __slots__ = ("pass_name", "path", "line", "end_line", "stmt_line",
+                 "anchor", "detail")
+
+    def __init__(self, pass_name: str, path: str, line: int,
+                 anchor: str, detail: str,
+                 end_line: Optional[int] = None,
+                 stmt_line: Optional[int] = None):
+        self.pass_name = pass_name
+        self.path = path
+        self.line = line
+        self.end_line = end_line if end_line is not None else line
+        # first line of the enclosing statement: the annotation window
+        # starts one line above THIS, so a multi-line statement can be
+        # annotated at its head even when the finding is mid-statement
+        self.stmt_line = stmt_line if stmt_line is not None else line
+        self.anchor = anchor
+        self.detail = detail
+
+    @property
+    def fid(self) -> str:
+        h = hashlib.sha1(
+            f"{self.path}|{self.pass_name}|{self.anchor}".encode()
+        ).hexdigest()[:8]
+        return f"{self.pass_name.upper().replace('-', '_')[:4]}-{h}"
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}: [{self.pass_name}] "
+                f"{self.fid} {self.detail}")
+
+
+class Module:
+    """One parsed source file: AST with parent links + the parsed
+    `# analysis:` annotations per line."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(src)
+        except SyntaxError as e:
+            self.tree = None
+            self.error = e
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    child._an_parent = node
+        # lineno -> (set of pass names, reason); malformed annotations
+        # land in self.bad_annotations instead
+        self.annotations: dict[int, tuple[set, str]] = {}
+        self.bad_annotations: list[tuple[int, str]] = []
+        for i, ln in enumerate(self.lines, start=1):
+            if not _ANNOT_INTENT_RE.search(ln):
+                continue
+            m = _ANNOT_RE.search(ln)
+            if m is None:
+                self.bad_annotations.append(
+                    (i, "does not parse as `# analysis: ok(<pass>) — "
+                        "<reason>`"))
+                continue
+            passes = {p.strip() for p in m.group(1).split(",")
+                      if p.strip()}
+            reason = (m.group(2) or "").strip()
+            unknown = passes - set(PASS_NAMES)
+            if not passes:
+                self.bad_annotations.append((i, "names no pass"))
+            elif unknown:
+                self.bad_annotations.append(
+                    (i, f"names unknown pass(es) {sorted(unknown)} — "
+                        f"known: {', '.join(PASS_NAMES)}"))
+            elif not reason:
+                self.bad_annotations.append(
+                    (i, "carries no reason — say WHY the finding is ok "
+                        "(`# analysis: ok(<pass>) — <reason>`)"))
+            else:
+                self.annotations[i] = (passes, reason)
+
+    @property
+    def modname(self) -> str:
+        name = self.path[:-3] if self.path.endswith(".py") else self.path
+        name = name.replace(os.sep, ".").replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[: -len(".__init__")]
+        return name
+
+    def ok_for(self, pass_name: str, lo: int, hi: int) -> bool:
+        """Is a finding of `pass_name` on statement lines [lo, hi]
+        suppressed by an annotation on those lines, or anywhere in the
+        contiguous comment block directly above the statement? (The
+        block rule lets a multi-line justification start with the
+        ``# analysis: ok(...)`` marker and keep explaining below it.)"""
+        for i in range(lo, hi + 1):
+            ann = self.annotations.get(i)
+            if ann is not None and pass_name in ann[0]:
+                return True
+        i = lo - 1
+        while i >= 1 and self.lines[i - 1].lstrip().startswith("#"):
+            ann = self.annotations.get(i)
+            if ann is not None and pass_name in ann[0]:
+                return True
+            i -= 1
+        return False
+
+
+def stmt_span(node: ast.AST) -> tuple[int, int]:
+    """(first, last) source line of the statement containing `node`."""
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = getattr(cur, "_an_parent", None)
+    cur = cur if cur is not None else node
+    lo = getattr(cur, "lineno", 0)
+    return lo, getattr(cur, "end_lineno", lo)
+
+
+def parent_chain(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "_an_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_an_parent", None)
+
+
+def enclosing_qual(node: ast.AST) -> str:
+    """'Class.method.nested' of the nearest enclosing defs — a stable,
+    line-free anchor for findings."""
+    names: list[str] = []
+    for p in parent_chain(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            names.append(p.name)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted_name(expr: ast.AST) -> str:
+    """'a.b.c' for nested Attribute/Name chains, '' when not one."""
+    parts: list[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("?")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+class Repo:
+    """The analyzable universe: the package's parsed modules plus the
+    raw text of docs/ and tests/ (the knob-discipline pass checks both
+    directions of doc/test drift) and any extra code roots that may
+    legitimately consume documented knobs (tools/, bench.py)."""
+
+    def __init__(self, modules: dict[str, Module],
+                 docs: Optional[dict[str, str]] = None,
+                 tests: Optional[dict[str, str]] = None,
+                 extra_code: Optional[dict[str, str]] = None):
+        self.modules = modules
+        self.docs = docs or {}
+        self.tests = tests or {}
+        self.extra_code = extra_code or {}
+        self._contexts = None
+
+    # ---- construction ----------------------------------------------------
+    @staticmethod
+    def _walk_py(root: str, rel_prefix: str) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.join(
+                    rel_prefix, os.path.relpath(path, root))
+                with open(path, encoding="utf-8") as f:
+                    out[rel.replace(os.sep, "/")] = f.read()
+        return out
+
+    @classmethod
+    def from_fs(cls, repo_root: str,
+                package: str = "emqx_tpu") -> "Repo":
+        pkg_root = os.path.join(repo_root, package)
+        modules = {p: Module(p, s)
+                   for p, s in cls._walk_py(pkg_root, package).items()}
+        docs: dict[str, str] = {}
+        docs_root = os.path.join(repo_root, "docs")
+        if os.path.isdir(docs_root):
+            for fn in sorted(os.listdir(docs_root)):
+                if fn.endswith(".md"):
+                    with open(os.path.join(docs_root, fn),
+                              encoding="utf-8") as f:
+                        docs[f"docs/{fn}"] = f.read()
+        tests: dict[str, str] = {}
+        tests_root = os.path.join(repo_root, "tests")
+        if os.path.isdir(tests_root):
+            tests = cls._walk_py(tests_root, "tests")
+        extra: dict[str, str] = {}
+        tools_root = os.path.join(repo_root, "tools")
+        if os.path.isdir(tools_root):
+            extra = cls._walk_py(tools_root, "tools")
+        bench = os.path.join(repo_root, "bench.py")
+        if os.path.exists(bench):
+            with open(bench, encoding="utf-8") as f:
+                extra["bench.py"] = f.read()
+        return cls(modules, docs=docs, tests=tests, extra_code=extra)
+
+    @classmethod
+    def from_sources(cls, files: dict[str, str],
+                     docs: Optional[dict[str, str]] = None,
+                     tests: Optional[dict[str, str]] = None,
+                     extra_code: Optional[dict[str, str]] = None
+                     ) -> "Repo":
+        return cls({p: Module(p, s) for p, s in files.items()},
+                   docs=docs, tests=tests, extra_code=extra_code)
+
+    # ---- the context engine (lazy, shared by the passes) -----------------
+    @property
+    def contexts(self):
+        if self._contexts is None:
+            from analysis.contexts import ContextGraph
+            self._contexts = ContextGraph(self)
+        return self._contexts
+
+
+def _load_passes() -> dict[str, Callable]:
+    from analysis.passes import cross_thread, hbm_hygiene, jit_purity, \
+        knob_discipline, loop_affinity, task_hygiene
+    return {
+        "loop-affinity": loop_affinity.run,
+        "cross-thread-state": cross_thread.run,
+        "jit-purity": jit_purity.run,
+        "knob-discipline": knob_discipline.run,
+        "task-hygiene": task_hygiene.run,
+        "hbm-hygiene": hbm_hygiene.run,
+    }
+
+
+def ALL_PASSES() -> dict[str, Callable]:
+    return _load_passes()
+
+
+def _annotation_findings(repo: Repo) -> list[Finding]:
+    """Malformed `# analysis:` comments are findings in their own
+    right: a typo'd suppression silently fails to suppress, which is
+    exactly the silent-drift class this framework exists to kill.
+    Never suppressible."""
+    out: list[Finding] = []
+    for mod in repo.modules.values():
+        for line, why in mod.bad_annotations:
+            out.append(Finding(
+                "annotation", mod.path, line,
+                f"line{line}:{mod.lines[line - 1].strip()[:60]}",
+                f"malformed analysis annotation: {why}"))
+        if mod.error is not None:
+            out.append(Finding(
+                "annotation", mod.path, mod.error.lineno or 0,
+                "syntax", f"module does not parse: {mod.error}"))
+    return out
+
+
+def run_repo(repo: Repo, passes: Optional[Iterable[str]] = None,
+             only: Optional[Iterable[str]] = None
+             ) -> tuple[list[Finding], list[Finding]]:
+    """Run the framework. Returns (findings, suppressed): `findings`
+    is what the caller should fail on, `suppressed` the annotated-ok
+    sites (reported for transparency, never fatal). `only` filters the
+    REPORT to a path subset — analysis always sees the whole repo, so
+    cross-file passes (contexts, knob discipline) stay sound on the
+    changed-files fast path."""
+    table = _load_passes()
+    names = list(passes) if passes else list(table)
+    for n in names:
+        if n not in table:
+            raise KeyError(
+                f"unknown pass {n!r} — known: {', '.join(table)}")
+    raw: list[Finding] = _annotation_findings(repo)
+    for n in names:
+        raw.extend(table[n](repo))
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        mod = repo.modules.get(f.path)
+        if mod is not None and f.pass_name != "annotation" \
+                and mod.ok_for(f.pass_name,
+                               min(f.stmt_line, f.line), f.end_line):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    if only is not None:
+        onlyset = {p.replace(os.sep, "/") for p in only}
+        findings = [f for f in findings if f.path in onlyset]
+        suppressed = [f for f in suppressed if f.path in onlyset]
+    key = lambda f: (f.path, f.line, f.pass_name)  # noqa: E731
+    return sorted(findings, key=key), sorted(suppressed, key=key)

@@ -1,0 +1,3 @@
+"""The analyzer passes. Each module exposes ``run(repo) -> [Finding]``
+and a ``NAME`` matching its key in ``analysis.core.PASS_NAMES``. See
+docs/ANALYSIS.md for the catalog and how to add one."""

@@ -1,0 +1,191 @@
+"""cross-thread-state: unguarded read-modify-write races on shared
+attributes.
+
+The defect class PR 7's review caught by hand: a counter incremented
+from executor-thread code (``self.recorded += 1`` in the flight
+recorder's hot path) while loop-side code reads or writes it — a
+preempted writer's stale store silently corrupts the count. Under
+CPython's GIL a PLAIN attribute store or load is atomic, and this
+codebase leans on that deliberately ("all gates are plain attribute
+reads — no locks on the serving path"), so plain stores/loads are NOT
+findings. The race needs a read-modify-write:
+
+- an ``AugAssign`` on ``self.attr`` (or on ``self.attr[key]``), or an
+  ``Assign`` to ``self.attr`` whose value reads the same attribute,
+- in a function the context engine classifies THREAD-reachable (it can
+  race loop code and its own pool siblings) — or loop-reachable while
+  a thread-context function of the same class writes the attribute,
+- with the attribute also touched from at least one OTHER method of
+  the class (a single-method private counter cannot race itself on
+  the loop),
+- and the RMW site not inside a ``with <...lock...>:`` block.
+
+Additionally, once a class guards an attribute with a lock anywhere,
+every non-``__init__`` WRITE of it must be guarded too — a
+half-locked attribute is worse than an unlocked one (the lock
+documents an intent the bypassing site silently breaks).
+
+Fix with a lock at both sites, or annotate the site with
+``# analysis: ok(cross-thread-state) — <why the race is benign>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from analysis.core import Finding, Repo, dotted_name, parent_chain, \
+    stmt_span
+from analysis.contexts import _body_walk
+
+NAME = "cross-thread-state"
+
+
+def _self_attr(expr) -> Optional[str]:
+    """'attr' when expr is self.attr (or self.attr[...]), else None."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+def _reads_attr(expr, attr: str) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == attr \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls") \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def _lock_guarded(node) -> bool:
+    """Is the site lexically inside `with <something lock-ish>:`?"""
+    for p in parent_chain(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(p, ast.With):
+            for item in p.items:
+                name = dotted_name(item.context_expr).lower()
+                if not name and isinstance(item.context_expr, ast.Call):
+                    name = dotted_name(
+                        item.context_expr.func).lower()
+                if "lock" in name or "mutex" in name or "cond" in name:
+                    return True
+    return False
+
+
+class _Site:
+    __slots__ = ("fi", "node", "attr", "write", "rmw", "guarded")
+
+    def __init__(self, fi, node, attr, write, rmw):
+        self.fi = fi
+        self.node = node
+        self.attr = attr
+        self.write = write
+        self.rmw = rmw
+        self.guarded = _lock_guarded(node)
+
+
+def _collect_sites(ci, graph) -> dict[str, list[_Site]]:
+    sites: dict[str, list[_Site]] = {}
+
+    def add(fi, node, attr, write, rmw):
+        sites.setdefault(attr, []).append(
+            _Site(fi, node, attr, write, rmw))
+
+    for fi in _class_funcs(ci, graph):
+        for node in _body_walk(fi.node):
+            if isinstance(node, ast.AugAssign):
+                a = _self_attr(node.target)
+                if a:
+                    add(fi, node, a, True, True)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a:
+                        add(fi, node, a, True,
+                            _reads_attr(node.value, a))
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                a = _self_attr(node)
+                if a:
+                    add(fi, node, a, False, False)
+    return sites
+
+
+def _class_funcs(ci, graph):
+    """The class's methods plus functions nested inside them (a worker
+    closure defined in a method touches the same self)."""
+    out = list(ci.methods.values())
+    i = 0
+    while i < len(out):
+        out.extend(out[i].nested.values())
+        i += 1
+    return out
+
+
+def run(repo: Repo) -> list[Finding]:
+    graph = repo.contexts
+    out: list[Finding] = []
+    for ci in graph.classes:
+        sites = _collect_sites(ci, graph)
+        for attr, ss in sites.items():
+            funcs = {s.fi for s in ss}
+            if len(funcs) < 2:
+                continue
+            thread_writers = [
+                s for s in ss if s.write
+                and "thread" in s.fi.contexts
+                and s.fi.name != "__init__"]
+            any_guarded = any(s.guarded for s in ss)
+            reported: set[int] = set()
+            for s in ss:
+                if not s.rmw or s.guarded \
+                        or s.fi.name == "__init__":
+                    continue
+                racy = None
+                if "thread" in s.fi.contexts:
+                    racy = ("runs on executor threads "
+                            f"({graph.chain_str(s.fi, 'thread')})")
+                elif "loop" in s.fi.contexts and any(
+                        w.fi is not s.fi for w in thread_writers):
+                    w = next(w for w in thread_writers
+                             if w.fi is not s.fi)
+                    racy = (f"races the thread-context write in "
+                            f"{w.fi.qualname} "
+                            f"({graph.chain_str(w.fi, 'thread')})")
+                if racy is None:
+                    continue
+                reported.add(id(s))
+                lo, hi = stmt_span(s.node)
+                out.append(Finding(
+                    NAME, s.fi.mod.path, s.node.lineno,
+                    f"{ci.name}.{attr}:rmw:{s.fi.qualname}",
+                    f"unguarded read-modify-write of self.{attr} "
+                    f"{racy}; also touched in "
+                    f"{sorted(f.qualname for f in funcs if f is not s.fi)[0]}"
+                    f" — lock both sites or annotate",
+                    end_line=hi, stmt_line=lo))
+            if any_guarded:
+                # the half-locked rule covers RMW sites too: an
+                # unguarded += in a method the context engine could
+                # not classify still breaks the intent the lock
+                # documents (only sites rule 1 already reported skip)
+                for s in ss:
+                    if not s.write or s.guarded \
+                            or s.fi.name == "__init__" \
+                            or id(s) in reported:
+                        continue
+                    lo, hi = stmt_span(s.node)
+                    out.append(Finding(
+                        NAME, s.fi.mod.path, s.node.lineno,
+                        f"{ci.name}.{attr}:bypass:{s.fi.qualname}",
+                        f"write to self.{attr} bypasses the lock that "
+                        f"guards it elsewhere in {ci.name} — guard it "
+                        f"or annotate why the bare store is safe",
+                        end_line=hi, stmt_line=lo))
+    return out

@@ -1,0 +1,102 @@
+"""hbm-hygiene: every persistent ``jax.device_put`` rides the HBM
+ledger.
+
+Migrated from tools/check_hbm_hygiene.py (ISSUE 8 satellite) onto the
+shared framework; the script remains as a CLI-compatible shim. The
+ledger (broker/hbm_ledger.py) only works if every persistent device
+allocation routes through it — one forgotten site and
+``accounted_fraction`` silently drifts below 1 while the capacity
+forecast under-counts. A ``device_put`` call is ACCOUNTED when any of:
+
+1. it is (transitively, within its statement) an argument of a
+   ``hold(...)``/``_hold(...)`` call — the direct-wrap idiom;
+2. its statement (or the line above) carries an ``# hbm:`` comment
+   naming where the hold happens or why the bytes are transient —
+   the split-site idiom (``# analysis: ok(hbm-hygiene) — <reason>``
+   works too, via the shared annotation grammar);
+3. it lives in ``broker/hbm_ledger.py`` itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Repo, enclosing_qual, parent_chain
+
+NAME = "hbm-hygiene"
+
+
+def _is_device_put(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "device_put"
+    if isinstance(fn, ast.Name):
+        return fn.id == "device_put"
+    return False
+
+
+def _is_hold(call: ast.Call) -> bool:
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    return name in ("hold", "_hold")
+
+
+def _inside_hold(node: ast.AST) -> bool:
+    """Is this device_put (transitively) an argument of a hold call?
+    The walk stops at statement boundaries — a hold elsewhere in the
+    function does not bless this put."""
+    for cur in parent_chain(node):
+        if isinstance(cur, ast.stmt):
+            return False
+        if isinstance(cur, ast.Call) and _is_hold(cur):
+            return True
+    return False
+
+
+def _stmt_of(node: ast.AST) -> ast.AST:
+    for cur in parent_chain(node):
+        if isinstance(cur, ast.stmt):
+            return cur
+    return node
+
+
+def _has_hbm_comment(lines: list, lo: int, hi: int) -> bool:
+    """`# hbm:` anywhere on source lines [lo, hi] (1-indexed), or on
+    the line just above (the split-site idiom puts the pointer comment
+    on its own line before the statement)."""
+    for ln in lines[max(0, lo - 2):hi]:
+        if "# hbm:" in ln:
+            return True
+    return False
+
+
+def check_module(mod) -> list[Finding]:
+    out: list[Finding] = []
+    if mod.tree is None or mod.path.endswith("hbm_ledger.py"):
+        return out
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_device_put(node)):
+            continue
+        if _inside_hold(node):
+            continue
+        stmt = _stmt_of(node)
+        lo = stmt.lineno
+        hi = getattr(stmt, "end_lineno", lo)
+        if _has_hbm_comment(mod.lines, lo, hi):
+            continue
+        out.append(Finding(
+            NAME, mod.path, node.lineno,
+            f"device_put:{enclosing_qual(node)}",
+            "jax.device_put bypasses the HBM ledger — wrap in "
+            "ledger.hold(category, ...) or annotate the statement "
+            "with `# hbm: <where held / why transient>`",
+            end_line=hi, stmt_line=lo))
+    return out
+
+
+def run(repo: Repo) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in repo.modules.values():
+        out.extend(check_module(mod))
+    return out

@@ -1,0 +1,117 @@
+"""jit-purity: fused route programs must be pure under trace.
+
+Every headline number rests on twin-oracle equivalence between the
+fused device programs and the host reference. A trace-impure program
+breaks that silently: the impurity runs ONCE at trace time, bakes a
+stale value into the compiled program, and every later call replays
+it — no exception, just wrong answers after the first recompile or a
+different-looking divergence per jit-cache entry. In any function the
+context engine classifies jit-reachable (the ``router_engine``
+fused-program registry seeds, plus everything they call), this pass
+flags:
+
+- mutation of ``global``/``nonlocal`` state (the declaration +
+  a store, or a subscript-store on a module-level name): trace-time
+  side effects run once, not per call;
+- wall-clock and RNG calls (``time.*``, ``random.*``,
+  ``np.random.*``): traced to a constant;
+- ``.item()`` / ``float()``-style host materialization is concretized
+  at trace time (``.item()`` additionally forces a device sync);
+- host callbacks (``io_callback`` / ``host_callback`` /
+  ``pure_callback`` / ``jax.debug.callback``): legal but must be a
+  deliberate, annotated decision in a serving-path program;
+- ``print(...)`` executes at trace time only — a debugging landmine.
+
+Deliberate exceptions (e.g. an op that is genuinely host-side too and
+only conditionally traced) carry
+``# analysis: ok(jit-purity) — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Repo, dotted_name, stmt_span
+from analysis.contexts import _body_walk
+
+NAME = "jit-purity"
+
+_HOST_CALLBACKS = ("io_callback", "host_callback", "pure_callback",
+                   "callback")
+_TIME_FNS = ("time", "perf_counter", "monotonic", "process_time",
+             "time_ns", "perf_counter_ns", "monotonic_ns", "sleep")
+
+
+def _module_level_names(mod) -> set:
+    out: set = set()
+    if mod.tree is None:
+        return out
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+def _impurity(node, mod_globals: set) -> str:
+    if isinstance(node, (ast.Global, ast.Nonlocal)):
+        kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+        return (f"declares `{kind} {', '.join(node.names)}` — "
+                f"mutating {kind} state under trace runs at trace "
+                f"time only")
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id in mod_globals:
+                return (f"subscript-store into module-level "
+                        f"`{t.value.id}` — a trace-time side effect, "
+                        f"runs once per compile, not per call")
+    if not isinstance(node, ast.Call):
+        return ""
+    fn = node.func
+    dot = dotted_name(fn)
+    attr = fn.attr if isinstance(fn, ast.Attribute) else dot
+    head = dot.split(".")[0] if dot else ""
+    if head == "time" and attr in _TIME_FNS:
+        return f"{dot} is traced to a constant (and sleep blocks)"
+    if head in ("random", "secrets") or dot.startswith("np.random") \
+            or dot.startswith("numpy.random"):
+        return f"{dot} under trace bakes one sample into the program"
+    if attr == "item":
+        return (".item() forces a host sync and concretizes the "
+                "traced value")
+    if attr in _HOST_CALLBACKS and (head in ("jax", "hcb") or
+                                    "callback" in dot):
+        return (f"host callback {dot} in a fused program — must be a "
+                f"deliberate, annotated decision")
+    if dot == "print":
+        return "print() under trace fires at trace time only"
+    return ""
+
+
+def run(repo: Repo) -> list[Finding]:
+    graph = repo.contexts
+    out: list[Finding] = []
+    for fi in graph.functions:
+        if "jit" not in fi.contexts:
+            continue
+        mod_globals = _module_level_names(fi.mod)
+        for node in _body_walk(fi.node):
+            why = _impurity(node, mod_globals)
+            if not why:
+                continue
+            lo, hi = stmt_span(node)
+            out.append(Finding(
+                NAME, fi.mod.path, node.lineno,
+                f"{fi.qualname}:{why[:40]}",
+                f"{why}; jit-reachable via "
+                f"{graph.chain_str(fi, 'jit')}",
+                end_line=hi, stmt_line=lo))
+    return out

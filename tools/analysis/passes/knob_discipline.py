@@ -1,0 +1,170 @@
+"""knob-discipline: every ``EMQX_TPU_*`` env knob resolves, is
+documented, and has a test reference.
+
+The repo's knob contract (established in PR 2 and repeated in every
+PR since): **config beats env beats default**, resolved in exactly one
+``resolve_*`` function per knob, with an off-twin test pinning the
+disabled behavior and a doc naming the knob. Drift in any leg is
+silent: an env read outside a resolver can't be overridden by config
+(the config value silently loses), an undocumented knob is invisible
+to operators, and an untested knob's off-path rots. Four checks:
+
+1. **resolver routing** — every AST-level read of an ``EMQX_TPU_*``
+   env var (``os.environ.get``/``[]``/``os.getenv``) must sit inside a
+   function whose name starts with ``resolve_`` (the per-knob
+   config-beats-env-beats-default resolver convention; module-level
+   one-shot knobs call their resolver at import:
+   ``_X = resolve_x()``).
+2. **doc presence** — the knob name appears in ``docs/*.md``
+   (extends PR 7's doc-drift gate from metric names to knobs).
+3. **test reference** — the knob name, or the ``broker.*``/``mqtt.*``
+   config key its resolver names, appears under ``tests/`` (the
+   off-twin test the A/B contract requires).
+4. **doc drift, reverse direction** — every ``EMQX_TPU_*`` token
+   cited in ``docs/*.md`` is read somewhere in the repo (package,
+   tools/, bench.py, tests/) — docs must not advertise dead knobs.
+
+Annotate deliberate exceptions with
+``# analysis: ok(knob-discipline) — <reason>`` at the env-read site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from analysis.core import Finding, Repo, dotted_name, parent_chain, \
+    stmt_span
+
+NAME = "knob-discipline"
+
+_KNOB_RE = re.compile(r"EMQX_TPU_[A-Z0-9_]+")
+_CONF_KEY_RE = re.compile(r"\b(?:broker|mqtt)\.[a-z][a-z0-9_]*")
+
+
+def _env_read(call: ast.Call) -> str:
+    """The EMQX_TPU_* name this call reads, or ''."""
+    dot = dotted_name(call.func)
+    # `import os as _os` is a live idiom (ops/shared.py) — match on
+    # the environ.get / getenv suffix, not the exact alias
+    if not (dot.endswith("environ.get") or dot.endswith(".getenv")
+            or dot == "getenv"):
+        return ""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str) \
+            and call.args[0].value.startswith("EMQX_TPU_"):
+        return call.args[0].value
+    return ""
+
+
+def _env_subscript(node: ast.Subscript) -> str:
+    if not dotted_name(node.value).endswith("environ"):
+        return ""
+    sl = node.slice
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+            and sl.value.startswith("EMQX_TPU_"):
+        return sl.value
+    return ""
+
+
+def _enclosing_resolver(node) -> str:
+    for p in parent_chain(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p.name if p.name.startswith("resolve_") else ""
+    return ""
+
+
+def _resolver_config_keys(node, mod) -> set:
+    """The broker.*/mqtt.* config keys the enclosing resolver names
+    (docstring or body) — the knob's test may pin the config twin
+    instead of the env name."""
+    for p in parent_chain(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lo = p.lineno
+            hi = getattr(p, "end_lineno", lo)
+            seg = "\n".join(mod.lines[lo - 1:hi])
+            return set(_CONF_KEY_RE.findall(seg))
+    return set()
+
+
+def run(repo: Repo) -> list[Finding]:
+    tests_blob = "\n".join(repo.tests.values())
+    docs_blob = "\n".join(repo.docs.values())
+    code_knob_reads: set[str] = set()
+    out: list[Finding] = []
+    for mod in repo.modules.values():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                knob = _env_read(node)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                knob = _env_subscript(node)
+            else:
+                continue
+            if not knob:
+                continue
+            code_knob_reads.add(knob)
+            lo, hi = stmt_span(node)
+            resolver = _enclosing_resolver(node)
+            if not resolver:
+                out.append(Finding(
+                    NAME, mod.path, node.lineno,
+                    f"{knob}:resolver",
+                    f"{knob} read outside a resolve_* function — "
+                    f"route it through a config-beats-env-beats-"
+                    f"default resolver (module-level knobs call the "
+                    f"resolver at import: `_X = resolve_x()`)",
+                    end_line=hi, stmt_line=lo))
+            if knob not in docs_blob:
+                out.append(Finding(
+                    NAME, mod.path, node.lineno,
+                    f"{knob}:docs",
+                    f"{knob} is read here but documented in no "
+                    f"docs/*.md — operators can't discover it",
+                    end_line=hi, stmt_line=lo))
+            conf_keys = _resolver_config_keys(node, mod)
+            # tests reference the config twin as a nested dict key
+            # ({"broker": {"topic_dedup": ...}}), so the bare last
+            # component counts as a reference too
+            if knob not in tests_blob and not any(
+                    k in tests_blob or k.split(".", 1)[1] in tests_blob
+                    for k in conf_keys):
+                alias = (f" (nor its config twin "
+                         f"{'/'.join(sorted(conf_keys))})"
+                         if conf_keys else "")
+                out.append(Finding(
+                    NAME, mod.path, node.lineno,
+                    f"{knob}:tests",
+                    f"{knob} appears in no test{alias} — the off-twin "
+                    f"A/B contract is unpinned",
+                    end_line=hi, stmt_line=lo))
+    # reverse doc drift: docs must not cite dead knobs. Findings anchor
+    # on the doc file; suppression is code-side only, so a dead doc
+    # knob can only be fixed by fixing the doc (or the code) — exactly
+    # the doc-drift-gate posture PR 7 set for metric names.
+    live = set(code_knob_reads)
+    for blob in repo.extra_code.values():
+        live.update(_KNOB_RE.findall(blob))
+    live.update(_KNOB_RE.findall(tests_blob))
+    for dpath, dtext in sorted(repo.docs.items()):
+        for i, ln in enumerate(dtext.splitlines(), start=1):
+            for m in _KNOB_RE.finditer(ln):
+                if m.group(0) not in live:
+                    out.append(Finding(
+                        NAME, dpath, i,
+                        f"{m.group(0)}:dead-doc",
+                        f"docs cite {m.group(0)} but nothing in the "
+                        f"repo reads it — dead knob or typo"))
+    # one finding per (file, defect), not one per read site — a knob
+    # read twice in one module is still one missing doc
+    seen: set[tuple[str, str]] = set()
+    deduped: list[Finding] = []
+    for f in out:
+        key = (f.path, f.anchor)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+    return deduped

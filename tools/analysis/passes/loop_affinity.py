@@ -1,0 +1,118 @@
+"""loop-affinity: blocking calls reachable inside loop-context code.
+
+The event loop is the broker's shared artery — every connection, lane
+worker, batcher stage and telemetry tick multiplexes over it. One
+blocking call in loop-reachable code stalls all of them at once (the
+PR 3 deflaking saga measured exactly this class: tens of ms of loop
+stall from an inline build). This pass flags, in any function the
+context engine classifies loop-reachable:
+
+- ``time.sleep(...)``
+- blocking ``<...lock...>.acquire()`` — the bare-acquire form whose
+  release may sit arbitrarily far away; ``with lock:`` critical
+  sections are the accepted idiom and are NOT flagged, and
+  ``acquire(blocking=False)`` / ``acquire(timeout=0)`` are non-blocking
+- sync subprocess use (``subprocess.run/call/check_*/Popen``,
+  ``os.system``)
+- sync socket ops (``.recv/.recvfrom/.accept/.sendall/.makefile`` on a
+  ``*sock*`` receiver, ``select.select``)
+- ``.block_until_ready()`` — a device sync on the loop stalls serving
+  for a full round-trip
+- ctypes native calls (any ``_lib.*`` call — the package's one ctypes
+  handle lives in ``emqx_tpu/native.py``)
+
+A call that is directly ``await``-ed is not blocking (that's the
+point of awaiting). Deliberate exceptions carry
+``# analysis: ok(loop-affinity) — <reason>`` at the blocking site; the
+finding names the loop-reachability chain so the reviewer can check
+the analyzer's claim, not just trust it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Repo, dotted_name, stmt_span
+from analysis.contexts import _body_walk
+
+NAME = "loop-affinity"
+
+_SUBPROCESS = ("run", "call", "check_call", "check_output", "Popen")
+_SOCK_METHODS = ("recv", "recvfrom", "accept", "sendall", "makefile")
+
+
+def _is_awaited(call: ast.Call) -> bool:
+    return isinstance(getattr(call, "_an_parent", None), ast.Await)
+
+
+def _kw(call: ast.Call, name: str):
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _nonblocking_acquire(call: ast.Call) -> bool:
+    b = _kw(call, "blocking")
+    if isinstance(b, ast.Constant) and b.value is False:
+        return True
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    t = _kw(call, "timeout")
+    return isinstance(t, ast.Constant) and t.value == 0
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    """Why this call blocks, or '' when it does not."""
+    fn = call.func
+    dot = dotted_name(fn)
+    attr = fn.attr if isinstance(fn, ast.Attribute) else dot
+    head = dot.split(".")[0] if dot else ""
+    if dot == "time.sleep":
+        return "time.sleep blocks the loop"
+    if attr == "acquire" and isinstance(fn, ast.Attribute):
+        recv = dotted_name(fn.value).lower()
+        if ("lock" in recv or "sem" in recv or "cond" in recv) \
+                and not _nonblocking_acquire(call):
+            return (f"blocking {dotted_name(fn)}() — use `with` for a "
+                    f"bounded critical section or acquire(blocking="
+                    f"False)")
+    if head == "subprocess" and attr in _SUBPROCESS:
+        return f"sync subprocess.{attr} blocks the loop"
+    if dot == "os.system":
+        return "os.system blocks the loop"
+    if dot == "select.select":
+        return "select.select blocks the loop"
+    if attr == "block_until_ready":
+        return (".block_until_ready() synchronizes with the device on "
+                "the loop — a full link round-trip of stall")
+    if attr in _SOCK_METHODS and isinstance(fn, ast.Attribute) \
+            and "sock" in dotted_name(fn.value).lower():
+        return f"sync socket .{attr} blocks the loop"
+    if head == "_lib":
+        return (f"ctypes native call {dot} holds the loop for its "
+                f"full native runtime")
+    return ""
+
+
+def run(repo: Repo) -> list[Finding]:
+    graph = repo.contexts
+    out: list[Finding] = []
+    for fi in graph.functions:
+        if "loop" not in fi.contexts:
+            continue
+        for node in _body_walk(fi.node):
+            if not isinstance(node, ast.Call) or _is_awaited(node):
+                continue
+            why = _blocking_reason(node)
+            if not why:
+                continue
+            lo, hi = stmt_span(node)
+            chain = graph.chain_str(fi, "loop")
+            out.append(Finding(
+                NAME, fi.mod.path, node.lineno,
+                f"{fi.qualname}:{dotted_name(node.func)}",
+                f"{why}; loop-reachable via {chain}",
+                end_line=hi, stmt_line=lo))
+    return out

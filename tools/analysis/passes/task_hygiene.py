@@ -1,0 +1,101 @@
+"""task-hygiene: fire-and-forget asyncio tasks + comment-less
+exception swallows.
+
+Migrated from tools/check_task_hygiene.py (ISSUE 6 satellite) onto the
+shared framework; the script remains as a CLI-compatible shim. The
+rules are unchanged:
+
+1. **fire-and-forget** — a bare-expression ``asyncio.create_task`` /
+   ``ensure_future`` discards its handle: the loop holds only a weak
+   reference (GC can collect the task mid-flight) and exceptions
+   surface, at best, as "Task exception was never retrieved" at
+   collection time. Use ``supervise.spawn(...)`` or hold the handle +
+   ``supervise.guard_task``.
+2. **except-pass** — ``except Exception: pass`` (or bare ``except:``)
+   with no comment. A best-effort swallow is sometimes right, but the
+   author owes the reader ONE line saying why; any comment in the
+   handler region (including ``# analysis: ok(...)``) satisfies it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, Repo, enclosing_qual
+
+NAME = "task-hygiene"
+
+_TASK_FNS = ("create_task", "ensure_future")
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_exception_catch(handler: ast.ExceptHandler) -> bool:
+    """bare `except:` or `except Exception/BaseException [as e]:`."""
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Attribute):
+        return t.attr in ("Exception", "BaseException")
+    return False
+
+
+def _has_comment(lines: list, lo: int, hi: int) -> bool:
+    """Any comment text on source lines [lo, hi] (1-indexed)? A string
+    scan is enough: the only '#' that can appear inside the code of an
+    `except ...: pass` region is in a string literal, and a string
+    literal in that region would itself be a (flagged) non-pass body."""
+    for ln in lines[lo - 1:hi]:
+        if "#" in ln:
+            return True
+    return False
+
+
+def check_module(mod) -> list[Finding]:
+    out: list[Finding] = []
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        # 1: fire-and-forget task — the Call is the entire statement
+        if isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Call) \
+                and _call_name(node.value) in _TASK_FNS:
+            out.append(Finding(
+                NAME, mod.path, node.lineno,
+                f"fire-and-forget:{_call_name(node.value)}"
+                f":{enclosing_qual(node)}",
+                f"{_call_name(node.value)}(...) result discarded — "
+                f"use supervise.spawn(...) or hold the handle + "
+                f"supervise.guard_task",
+                end_line=getattr(node, "end_lineno", node.lineno)))
+        # 2: comment-less `except Exception: pass`
+        if isinstance(node, ast.ExceptHandler) \
+                and _is_exception_catch(node) \
+                and len(node.body) == 1 \
+                and isinstance(node.body[0], ast.Pass):
+            hi = node.body[0].lineno
+            if not _has_comment(mod.lines, node.lineno, hi):
+                out.append(Finding(
+                    NAME, mod.path, node.lineno,
+                    f"except-pass:{enclosing_qual(node)}",
+                    "except Exception: pass with no explaining "
+                    "comment — say why the swallow is safe (or stop "
+                    "swallowing)",
+                    end_line=hi))
+    return out
+
+
+def run(repo: Repo) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in repo.modules.values():
+        out.extend(check_module(mod))
+    return out

@@ -1,40 +1,25 @@
 #!/usr/bin/env python
-"""Static HBM-hygiene pass over emqx_tpu/ (ISSUE 8 satellite).
+"""Static HBM-hygiene pass over emqx_tpu/ — CLI-compatible shim.
 
-The HBM ledger (broker/hbm_ledger.py) only works if every persistent
-`jax.device_put` actually routes through it — one forgotten site and
-`accounted_fraction` silently drifts below 1 while the capacity
-forecast (tools/hbm_report.py) under-counts. This audit is the static
-half of that guarantee (the runtime half is the `memory_stats()`
-cross-check in the telemetry section): it flags every `device_put`
-call in emqx_tpu/ that bypasses the ledger.
-
-A `device_put` call is ACCOUNTED when any of:
-
-1. it is (transitively) an argument of a `hold(...)` / `_hold(...)`
-   call — the direct-wrap idiom
-   (``self._hold("snapshot_tables", jax.device_put(tables))``);
-2. its statement, or the line right above it, carries an ``# hbm:``
-   comment naming where the hold happens — the split-site idiom
-   (``parallel/sharded.py`` holds the tree two lines below the put,
-   inside a ``jax.tree.map``) or an explicit transient exemption
-   (``# hbm: transient — consumed by this dispatch``);
-3. it lives in ``broker/hbm_ledger.py`` itself.
-
-Anything else is a finding: either wrap it in ``ledger.hold`` (with
-the knob-off `None` passthrough every call site already has) or write
-the one ``# hbm:`` line saying why the bytes are not persistent. The
-sibling of tools/check_task_hygiene.py: run as a script (exit 1 on
-findings) or through ``check(root)`` from the tier-1 test
-(tests/test_hbm_ledger.py wires it in, so a bypassing allocation
-fails CI).
+The real pass now lives in the unified analyzer
+(``tools/analysis/passes/hbm_hygiene.py`` — ISSUE 12 migrated both
+ad-hoc checkers onto the shared AST/framework infrastructure; see
+docs/ANALYSIS.md). This shim keeps the original entry points bit-
+compatible so existing tier-1 wiring (tests/test_hbm_ledger.py) and
+muscle memory keep working: ``check_source(path, src)`` /
+``check(root)`` return legacy ``Finding`` objects, the script prints
+the same report and exits 1 on findings, 0 clean.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from analysis.core import Module                      # noqa: E402
+from analysis.passes import hbm_hygiene as _pass      # noqa: E402
 
 
 class Finding:
@@ -47,79 +32,17 @@ class Finding:
         return f"{self.path}:{self.line}: [hbm] {self.detail}"
 
 
-def _is_device_put(call: ast.Call) -> bool:
-    fn = call.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr == "device_put"
-    if isinstance(fn, ast.Name):
-        return fn.id == "device_put"
-    return False
-
-
-def _is_hold(call: ast.Call) -> bool:
-    fn = call.func
-    name = fn.attr if isinstance(fn, ast.Attribute) else \
-        fn.id if isinstance(fn, ast.Name) else ""
-    return name in ("hold", "_hold")
-
-
-def _annotate_parents(tree: ast.AST) -> None:
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            child._hbm_parent = node
-
-
-def _inside_hold(node: ast.AST) -> bool:
-    """Is this device_put (transitively) an argument of a hold call?
-    The walk stops at statement boundaries — a hold elsewhere in the
-    function does not bless this put."""
-    cur = getattr(node, "_hbm_parent", None)
-    while cur is not None and not isinstance(cur, ast.stmt):
-        if isinstance(cur, ast.Call) and _is_hold(cur):
-            return True
-        cur = getattr(cur, "_hbm_parent", None)
-    return False
-
-
-def _stmt_of(node: ast.AST) -> ast.AST:
-    cur = node
-    while cur is not None and not isinstance(cur, ast.stmt):
-        cur = getattr(cur, "_hbm_parent", None)
-    return cur if cur is not None else node
-
-def _has_hbm_comment(lines: list[str], lo: int, hi: int) -> bool:
-    """`# hbm:` anywhere on source lines [lo, hi] (1-indexed), or on
-    the line just above (the split-site idiom puts the pointer comment
-    on its own line before the statement)."""
-    for ln in lines[max(0, lo - 2):hi]:
-        if "# hbm:" in ln:
-            return True
-    return False
-
-
 def check_source(path: str, src: str) -> list[Finding]:
-    out: list[Finding] = []
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, f"syntax: {e}")]
-    _annotate_parents(tree)
-    lines = src.splitlines()
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and _is_device_put(node)):
-            continue
-        if _inside_hold(node):
-            continue
-        stmt = _stmt_of(node)
-        hi = getattr(stmt, "end_lineno", stmt.lineno)
-        if _has_hbm_comment(lines, stmt.lineno, hi):
-            continue
-        out.append(Finding(
-            path, node.lineno,
-            "jax.device_put bypasses the HBM ledger — wrap in "
-            "ledger.hold(category, ...) or annotate the statement "
-            "with `# hbm: <where held / why transient>`"))
-    return out
+    mod = Module(path, src)
+    if mod.error is not None:
+        return [Finding(path, mod.error.lineno or 0,
+                        f"syntax: {mod.error}")]
+    # honor the shared `# analysis: ok(hbm-hygiene) — ...` grammar the
+    # framework applies, so this gate and `make analyze` always agree
+    return [Finding(f.path, f.line, f.detail)
+            for f in _pass.check_module(mod)
+            if not mod.ok_for(_pass.NAME,
+                              min(f.stmt_line, f.line), f.end_line)]
 
 
 def check(root: str) -> list[Finding]:

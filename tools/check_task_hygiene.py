@@ -1,38 +1,29 @@
 #!/usr/bin/env python
-"""Static task-hygiene pass over emqx_tpu/ (ISSUE 6 satellite).
+"""Static task-hygiene pass over emqx_tpu/ — CLI-compatible shim.
 
-Two classes of silent-failure bugs keep reappearing in asyncio code,
-and both defeated the pipeline's observability before the supervision
-layer landed (a lane or consumer task could die between windows with no
-trace):
+The real pass now lives in the unified analyzer
+(``tools/analysis/passes/task_hygiene.py`` — ISSUE 12 migrated both
+ad-hoc checkers onto the shared AST/framework infrastructure; see
+docs/ANALYSIS.md). This shim keeps the original entry points bit-
+compatible so existing tier-1 wiring (tests/test_supervise.py) and
+muscle memory keep working:
 
-1. **Fire-and-forget tasks** — an ``asyncio.create_task(...)`` /
-   ``ensure_future(...)`` whose handle is discarded (a bare expression
-   statement). The loop holds only a weak reference (GC can collect the
-   task mid-flight) and any exception is deferred to a
-   "Task exception was never retrieved" warning at collection time, if
-   ever. The fix is ``supervise.spawn(...)`` (strong ref + logged/
-   counted death) or holding the handle + ``supervise.guard_task``.
-
-2. **Swallowed exceptions** — ``except Exception: pass`` (or a bare
-   ``except:``) with no explanation. Sometimes legitimate (best-effort
-   cleanup), but then the author owes the reader one comment line
-   saying why; a COMMENT-LESS swallow is indistinguishable from a bug.
-   Handlers carrying any comment (e.g. ``# noqa: BLE001 — best-effort``)
-   are accepted.
-
-Run as a script (exit 1 on findings, grep-friendly report) or through
-``check(paths)`` from the tier-1 test (tests/test_supervise.py wires it
-in, so a regression fails CI).
+- ``check_source(path, src)`` / ``check(root)`` return legacy
+  ``Finding`` objects with ``.kind`` in {"fire-and-forget",
+  "except-pass", "syntax"};
+- running as a script prints the same grep-friendly report and exits
+  1 on findings, 0 clean.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-_TASK_FNS = ("create_task", "ensure_future")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from analysis.core import Module                      # noqa: E402
+from analysis.passes import task_hygiene as _pass     # noqa: E402
 
 
 class Finding:
@@ -46,68 +37,21 @@ class Finding:
         return f"{self.path}:{self.line}: [{self.kind}] {self.detail}"
 
 
-def _call_name(call: ast.Call) -> str:
-    fn = call.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
-
-
-def _is_exception_catch(handler: ast.ExceptHandler) -> bool:
-    """bare `except:` or `except Exception/BaseException [as e]:`."""
-    t = handler.type
-    if t is None:
-        return True
-    if isinstance(t, ast.Name):
-        return t.id in ("Exception", "BaseException")
-    if isinstance(t, ast.Attribute):
-        return t.attr in ("Exception", "BaseException")
-    return False
-
-
-def _has_comment(lines: list[str], lo: int, hi: int) -> bool:
-    """Any comment text on source lines [lo, hi] (1-indexed)? A string
-    scan is enough: the only '#' that can appear inside the code of an
-    `except ...: pass` region is in a string literal, and a string
-    literal in that region would itself be a (flagged) non-pass body."""
-    for ln in lines[lo - 1:hi]:
-        if "#" in ln:
-            return True
-    return False
+def _legacy(f) -> Finding:
+    kind = f.anchor.split(":", 1)[0]
+    return Finding(f.path, f.line, kind, f.detail)
 
 
 def check_source(path: str, src: str) -> list[Finding]:
-    out: list[Finding] = []
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, "syntax", str(e))]
-    lines = src.splitlines()
-    for node in ast.walk(tree):
-        # 1: fire-and-forget task — the Call is the entire statement
-        if isinstance(node, ast.Expr) \
-                and isinstance(node.value, ast.Call) \
-                and _call_name(node.value) in _TASK_FNS:
-            out.append(Finding(
-                path, node.lineno, "fire-and-forget",
-                f"{_call_name(node.value)}(...) result discarded — "
-                f"use supervise.spawn(...) or hold the handle + "
-                f"supervise.guard_task"))
-        # 2: comment-less `except Exception: pass`
-        if isinstance(node, ast.ExceptHandler) \
-                and _is_exception_catch(node) \
-                and len(node.body) == 1 \
-                and isinstance(node.body[0], ast.Pass):
-            hi = node.body[0].lineno
-            if not _has_comment(lines, node.lineno, hi):
-                out.append(Finding(
-                    path, node.lineno, "except-pass",
-                    "except Exception: pass with no explaining "
-                    "comment — say why the swallow is safe (or stop "
-                    "swallowing)"))
-    return out
+    mod = Module(path, src)
+    if mod.error is not None:
+        return [Finding(path, mod.error.lineno or 0, "syntax",
+                        str(mod.error))]
+    # honor the shared `# analysis: ok(task-hygiene) — ...` grammar the
+    # framework applies, so this gate and `make analyze` always agree
+    return [_legacy(f) for f in _pass.check_module(mod)
+            if not mod.ok_for(_pass.NAME,
+                              min(f.stmt_line, f.line), f.end_line)]
 
 
 def check(root: str) -> list[Finding]:
