@@ -84,7 +84,10 @@ class SysBroker:
         `pipeline/memory` (HBM ledger: per-category device bytes, pin
         ages, backend memory_stats cross-check, ISSUE 8) /
         `pipeline/program_costs` (jit-program cost registry: compile
-        wall per class, flops/bytes where analyzed, ISSUE 8)."""
+        wall per class, flops/bytes where analyzed, ISSUE 8) /
+        `pipeline/latency` (end-to-end latency SLO observatory:
+        per-(qos, path) ingress→routed / ingress→delivered
+        percentiles, SLO burn rates, breach exemplars, ISSUE 13)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -101,7 +104,7 @@ class SysBroker:
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
                         "deliver", "supervise", "trace", "ingress",
-                        "memory", "program_costs"):
+                        "memory", "program_costs", "latency"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

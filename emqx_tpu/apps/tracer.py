@@ -76,12 +76,14 @@ class Tracer:
         h.add("session.subscribed", self.on_session_subscribed, tag="tracer")
         h.add("batch.slow", self.on_batch_slow, tag="tracer")
         h.add("pipeline.pin_stale", self.on_pin_stale, tag="tracer")
+        h.add("latency.breach", self.on_latency_breach, tag="tracer")
         return self
 
     def unload(self) -> None:
         for hp in ("message.publish", "client.connected",
                    "client.disconnected", "session.subscribed",
-                   "batch.slow", "pipeline.pin_stale"):
+                   "batch.slow", "pipeline.pin_stale",
+                   "latency.breach"):
             self.node.hooks.delete(hp, "tracer")
         for t in self._traces.values():
             t.close()
@@ -167,6 +169,34 @@ class Tracer:
         for t in self._traces.values():
             if t.kind == "slow_batch":
                 t.write(line)
+
+    def on_latency_breach(self, ex: dict) -> None:
+        """`latency.breach` hook (broker.latency, ISSUE 13): a message
+        exceeded the ingress→routed SLO objective. The exemplar carries
+        its window's flight-recorder trace id, so the log line names
+        the CAUSAL CHAIN of the exact slow message — queue wait vs
+        dispatch vs materialize vs lane backpressure — not an
+        aggregate. The observatory throttles the hook to one fire per
+        second, so a degraded pipeline (every message breaching) logs
+        one chain per second, never one per message."""
+        line = ("SLO_BREACH " +
+                " ".join(f"{k}={ex[k]}" for k in sorted(ex)))
+        rec = getattr(self.node, "flight_recorder", None)
+        tid = ex.get("trace_id")
+        if rec is not None and tid:
+            try:
+                spans = sorted(
+                    (s for s in rec.spans()
+                     if s.trace_id == tid and s.t1 > s.t0
+                     and s.name not in ("window", "message")),
+                    key=lambda s: s.t0)
+                chain = ",".join(f"{s.name}:{s.dur * 1000:.1f}ms"
+                                 for s in spans[:12])
+                if chain:
+                    line += f" chain={chain}"
+            except Exception:  # noqa: BLE001 — context is best-effort
+                pass
+        log.warning("%s", line)
 
     def on_pin_stale(self, info: dict) -> None:
         """`pipeline.pin_stale` hook (broker.hbm_ledger, ISSUE 8): a

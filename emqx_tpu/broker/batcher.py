@@ -115,6 +115,11 @@ class PublishBatcher:
         # dispatch/materialize/replay/lanes to settle. None (knob off /
         # bare test nodes) restores the pre-ISSUE-7 behavior exactly.
         self.rec = getattr(node, "flight_recorder", None)
+        # latency SLO observatory (ISSUE 13): per-message ingress→
+        # routed / ingress→delivered recording at settle, keyed by the
+        # window's (qos, path) attribution. None (knob off / bare test
+        # nodes) restores the pre-ISSUE-13 behavior exactly.
+        self.obs = getattr(node, "latency_observatory", None)
         self.window_s = window_us / 1e6
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
@@ -407,6 +412,14 @@ class PublishBatcher:
                     lives = [e["live"] for e in group if e["live"]]
                     if use_device and lives:
                         handle = self.engine.prepare_window(lives)
+                        if handle is None:
+                            # the device path was CHOSEN but declined
+                            # (mid-rebuild, gated swap): these entries
+                            # route host-side as the host_fallback
+                            # latency series, not plain host — a
+                            # rebuild storm shows up as its own tail
+                            for e in group:
+                                e["fallback"] = True
                         if handle is not None:
                             dispatched = True
                             k = 0
@@ -597,8 +610,23 @@ class PublishBatcher:
         counts = [0] * len(batch)
         tele = self.tele
         rec = self.rec
+        obs = self.obs
         tid = entry.get("trace") if rec is not None else None
         path = "host" if routed is None else "device"
+        # latency path attribution (ISSUE 13): the fine-grained series
+        # key. The coarse `path` above keeps its two historical values
+        # (trace window meta, record_total meta) — the observatory's
+        # five-way split is its own dimension.
+        if routed is not None:
+            lpath = "device_cached" \
+                if getattr(entry.get("handle"), "plan", None) is not None \
+                else "device"
+        elif entry.get("replayed"):
+            lpath = "replay"
+        elif entry.get("fallback") or entry.get("handle") is not None:
+            lpath = "host_fallback"
+        else:
+            lpath = "host"
         try:
             if "error" in entry:
                 raise entry["error"]
@@ -656,6 +684,19 @@ class PublishBatcher:
                 # the next device sample must be a full round-trip, not
                 # completion-to-completion across this host batch
                 self._last_dev_done = None
+            if obs is not None and live:
+                # ingress→routed (ISSUE 13): the route result for every
+                # live message is in hand — device windows arrive here
+                # with `routed` precomputed (finish_sub just returned),
+                # host/fallback/replay rungs just finished the trie
+                # walk. Only socket-ingress messages carry a stamp.
+                t_ns = time.perf_counter_ns()
+                tr = entry.get("trace", 0)
+                for m in live:
+                    ing = m.ingress_ns
+                    if ing:
+                        obs.record_routed(m, lpath, (t_ns - ing) / 1e9,
+                                          trace=tr)
             def _settle() -> None:
                 if live:
                     for j, i in enumerate(live_idx):
@@ -665,6 +706,17 @@ class PublishBatcher:
                         fut.set_result(counts[i])
                 if self.sup is not None:
                     self.sup.journal_settle(entry.get("wid"))
+                if obs is not None and live:
+                    # ingress→delivered (ISSUE 13): _settle runs when
+                    # the deliveries are written — inline for host
+                    # batches, via the DeliveryPlan done-callback when
+                    # the PR 5 lanes own the walk
+                    t_ns = time.perf_counter_ns()
+                    for m in live:
+                        ing = m.ingress_ns
+                        if ing:
+                            obs.record_delivered(m, lpath,
+                                                 (t_ns - ing) / 1e9)
                 # PUBLISH→route latency sample: oldest enqueue →
                 # completion (covers both host- and device-routed
                 # entries — the device path funnels through here with
@@ -1046,6 +1098,13 @@ class PublishBatcher:
         child span of the window root, and the host_route that follows
         parents to the replay — the causal chain survives the
         supervise replay."""
+        if entry is not None:
+            # latency path attribution (ISSUE 13): a supervised journal
+            # replay lands in the `replay` series, an unsupervised
+            # device failure in `host_fallback` — independent of the
+            # flight-recorder knob below
+            entry["replayed" if self.sup is not None
+                  else "fallback"] = True
         rec = self.rec
         if rec is None or entry is None or "trace" not in entry:
             return

@@ -521,6 +521,11 @@ class Channel:
                             "peername": self.conninfo.get("peername"),
                             "properties": props,
                             "proto_ver": self.proto_ver})
+        if pkt.ingress_ns:
+            # ingress stamp (ISSUE 13): frame-decode clock rides the
+            # message so the latency observatory can attribute this
+            # message's e2e spans at settle
+            msg.ingress_ns = pkt.ingress_ns
         self.node.metrics.inc_msg_recv(pkt.qos)
 
         if pkt.qos == C.QOS_0:
@@ -672,6 +677,10 @@ class Channel:
                 "flags": {"retain": retain, "dup": burst.dup[j]},
                 "headers": dict(base_headers, properties=props),
                 "id": ids[j], "ts": ts_ms, "extra": {},
+                # ISSUE 13: the burst's one frame-decode clock read,
+                # attributed per row (stamp-equivalent to the
+                # per-packet path's pkt.ingress_ns carry)
+                "ingress_ns": burst.ingress_ns,
             }
             qos_counts[qos] += 1
             rows.append((msg, qos > 0))

@@ -101,6 +101,19 @@ DEFAULTS: dict = {
         # EMQX_TPU_INGRESS_LANES, then min(4, cpus); must be >= 1;
         # columnar_ingress=0 forces 1)
         "ingress_lanes": None,
+        # None = resolve via EMQX_TPU_LATENCY, then default-on
+        # (broker/latency.resolve_latency_observatory); false restores
+        # the pre-ISSUE-13 observable behavior (no observatory object,
+        # no `latency` snapshot section, REST /pipeline/latency 404,
+        # bit-identical delivery counts/order) — the A/B baseline; the
+        # frame-decode ingress stamp itself stays on (negligible, see
+        # the resolver docstring). A baked-in bool here would shadow
+        # the env knob through the defaults merge.
+        "latency_observatory": None,
+        # end-to-end SLO objective in ms for the ingress→routed p99
+        # (None = EMQX_TPU_SLO_ROUTE_P99_MS, then 2.0 — the ROADMAP
+        # p99 < 2ms PUBLISH→route criterion; must be > 0)
+        "slo_route_p99_ms": None,
         # stale-pin sentinel threshold in windows (None =
         # EMQX_TPU_PIN_WARN_WINDOWS, then 64; must be > 0): a dispatch
         # handle pinning its snapshot longer than this fires the

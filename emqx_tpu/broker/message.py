@@ -92,6 +92,17 @@ class Message:
     ts: int = 0                            # ms epoch
     extra: dict = field(default_factory=dict)
 
+    # ingress stamp (ISSUE 13): perf_counter_ns at frame decode,
+    # carried from the Publish packet / PublishBurst by the channel so
+    # the latency observatory can record this message's ingress→routed
+    # and ingress→delivered spans at batch settle. A plain class
+    # attribute, not a dataclass field: every message answers 0 with no
+    # per-instance cost and the dataclass __init__/eq/repr contract is
+    # untouched; only socket-ingress messages ever carry a real stamp
+    # (internal publishes — $SYS, bridges, rule republish — stay 0 and
+    # are deliberately excluded from the e2e percentiles).
+    ingress_ns = 0
+
     def __post_init__(self):
         if not self.id:
             self.id = _GUID.next()

@@ -76,24 +76,39 @@ ALL_METRICS = (BYTES_METRICS + PACKET_METRICS + MESSAGE_METRICS +
 class Histogram:
     """Fixed log2-bucket histogram with wait-free increments.
 
-    Bucket bounds are `lo * 2**i` for i in [0, n_buckets); an observation
-    lands in the first bucket whose bound is >= the value (values <= lo —
-    including 0 — land in bucket 0; values beyond the last bound land in
-    the overflow bucket, visible only as the +Inf series). Increments are
-    a frexp + two int adds under the GIL — the same practical wait-free
-    property as the plain counters (emqx_metrics' counters:add analog;
-    the bucket layout mirrors prometheus.erl's default log-scale
-    histogram support).
+    Bucket bounds are `lo * 2**(i/substeps)` for i in [0, n_buckets); an
+    observation lands in the first bucket whose bound is >= the value
+    (values <= lo — including 0 — land in bucket 0; values beyond the
+    last bound land in the overflow bucket, visible only as the +Inf
+    series). Increments are a frexp + two int adds under the GIL — the
+    same practical wait-free property as the plain counters
+    (emqx_metrics' counters:add analog; the bucket layout mirrors
+    prometheus.erl's default log-scale histogram support).
+
+    ``substeps`` (ISSUE 13 satellite) is the sub-millisecond fine mode:
+    the default 1 keeps the classic one-bucket-per-octave ladder, while
+    substeps=4 interleaves quarter-octave bounds (step 2^(1/4) ≈ 1.19x)
+    so a µs-floored ladder can resolve a 2ms SLO objective — the plain
+    ladder's neighbouring bounds sit at 1.024ms and 2.048ms, a factor-2
+    ambiguity exactly where the north-star criterion lives. Percentiles
+    then over-estimate by at most one sub-step instead of one octave.
     """
 
-    __slots__ = ("name", "unit", "lo", "bounds", "counts", "sum", "count")
+    __slots__ = ("name", "unit", "lo", "substeps", "bounds", "counts",
+                 "sum", "count")
 
     def __init__(self, name: str, *, lo: float = 1e-6,
-                 n_buckets: int = 28, unit: str = "seconds"):
+                 n_buckets: int = 28, unit: str = "seconds",
+                 substeps: int = 1):
         self.name = name
         self.unit = unit
         self.lo = lo
-        self.bounds = [lo * (1 << i) for i in range(n_buckets)]
+        self.substeps = max(1, int(substeps))
+        if self.substeps == 1:
+            self.bounds = [lo * (1 << i) for i in range(n_buckets)]
+        else:
+            self.bounds = [lo * 2 ** (i / self.substeps)
+                           for i in range(n_buckets)]
         self.counts = [0] * (n_buckets + 1)    # [-1] is overflow (+Inf)
         self.sum = 0.0
         self.count = 0
@@ -101,9 +116,22 @@ class Histogram:
     def _index(self, v: float) -> int:
         if v <= self.lo:
             return 0
-        m, e = math.frexp(v / self.lo)     # v/lo = m * 2^e, m in [0.5, 1)
-        i = e - 1 if m == 0.5 else e       # smallest i with v <= lo*2^i
-        return min(i, len(self.bounds))    # beyond last bound -> overflow
+        if self.substeps == 1:
+            m, e = math.frexp(v / self.lo)  # v/lo = m * 2^e, m in [0.5,1)
+            i = e - 1 if m == 0.5 else e    # smallest i with v <= lo*2^i
+            return min(i, len(self.bounds))  # beyond last bound: overflow
+        # fine mode: log2 gives the neighbourhood, a bounded forward
+        # probe settles exact-bound float edges (never more than a
+        # couple of steps — the exactness of frexp without trusting
+        # log2 rounding at bucket boundaries)
+        i = max(0, int(self.substeps * math.log2(v / self.lo)) - 1)
+        b = self.bounds
+        n = len(b)
+        if i > n:                           # far beyond the last bound
+            return n
+        while i < n and b[i] < v:
+            i += 1
+        return i                            # i == n -> overflow
 
     def observe(self, v: float) -> None:
         self.counts[self._index(v)] += 1
@@ -123,8 +151,10 @@ class Histogram:
 
     def percentile(self, p: float) -> float:
         """Upper bucket bound at quantile p (0..1) — an over-estimate by
-        at most one log2 step. Overflow observations clamp to twice the
-        last finite bound (keeps snapshots JSON-finite)."""
+        at most one bucket step (one octave at substeps=1, one
+        quarter-octave ≈ 1.19x in the substeps=4 fine mode). Overflow
+        observations clamp to twice the last finite bound (keeps
+        snapshots JSON-finite)."""
         if self.count == 0:
             return 0.0
         want = p * self.count

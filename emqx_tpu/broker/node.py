@@ -143,6 +143,28 @@ class Node:
                 hooks=self.hooks, recorder=self.flight_recorder)
             self.pipeline_telemetry.ledger = self.hbm_ledger
             self.stats.register_stats_fun(self.hbm_ledger.stats_fun)
+        # end-to-end latency SLO observatory (ISSUE 13): per-message
+        # ingress→routed / ingress→delivered percentiles keyed by
+        # (qos, path), the SLO burn engine and breach exemplars.
+        # Stamps start at frame decode (mqtt/frame), ride Message
+        # through the batcher/host paths, and are recorded at settle.
+        # broker.latency_observatory / EMQX_TPU_LATENCY =0 restores the
+        # pre-ISSUE-13 observable behavior (self.latency_observatory
+        # stays None everywhere: no `latency` snapshot section, REST
+        # 404; the frame-decode stamp itself stays on — see the
+        # resolver docstring).
+        # Deliberately NOT gated on use_device: the host-only twin
+        # measures the same e2e legs (path `host`).
+        self.latency_observatory = None
+        from emqx_tpu.broker.latency import (LatencyObservatory,
+                                             resolve_latency_observatory)
+        if resolve_latency_observatory(perf.get("latency_observatory")):
+            self.latency_observatory = LatencyObservatory(
+                self.metrics, hooks=self.hooks,
+                recorder=self.flight_recorder,
+                objective_ms=perf.get("slo_route_p99_ms"))
+            self.pipeline_telemetry.observatory = self.latency_observatory
+            self.broker.latency_obs = self.latency_observatory
         # session-affine delivery lanes (ISSUE 5): the overlapped egress
         # stage both engines' consume hands plans to. 0 lanes (config
         # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores

@@ -14,6 +14,7 @@ subscriber id (the "session row" of the device tables); the reference's
 from __future__ import annotations
 
 import random
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
@@ -76,6 +77,12 @@ class Broker:
         # set by DeviceRouteEngine: membership-churn listener for the
         # compiled device snapshot
         self.device_engine = None
+        # set by Node when the latency observatory (ISSUE 13) is on:
+        # the per-message host publish path (no batcher — pure host
+        # nodes, gateways awaiting publish_async directly) records its
+        # ingress→routed/delivered spans here; the batcher-owned paths
+        # record at batch settle instead, never both for one message
+        self.latency_obs = None
 
         self._subscribers: dict[int, Subscriber] = {}
         self._sub_meta: dict[int, str] = {}     # sid -> clientid
@@ -215,7 +222,15 @@ class Broker:
         if msg is None or msg.get_header("allow_publish") is False:
             return 0
         self.metrics.inc("messages.publish")
-        return self._route(msg, self.router.match(msg.topic))
+        n = self._route(msg, self.router.match(msg.topic))
+        obs = self.latency_obs
+        if obs is not None and msg.ingress_ns:
+            # ISSUE 13, batcher-less host path: routing and delivery
+            # are one inline walk, so both legs share the settle clock
+            s = (time.perf_counter_ns() - msg.ingress_ns) / 1e9
+            obs.record_routed(msg, "host", s)
+            obs.record_delivered(msg, "host", s)
+        return n
 
     def publish_soon(self, msg: Message) -> None:
         """Fire-and-forget publish from sync code paths (will messages,

@@ -56,8 +56,13 @@ SCHEMA = "emqx_tpu.pipeline/v1"
 STAGES = ("enqueue", "batch_form", "dispatch", "dispatch_cached",
           "materialize", "deliver", "host_route", "host_match", "total")
 
-# stage histograms: 1us .. ~134s in 28 log2 buckets
-_STAGE_LO, _STAGE_BUCKETS = 1e-6, 28
+# stage histograms: 1µs floor, quarter-octave fine ladder (ISSUE 13
+# satellite: the watchdog deadlines derive from these histograms' p99,
+# and the plain octave ladder could not resolve the 2ms SLO objective
+# — neighbouring bounds at 1.024/2.048ms). 112 quarter-octave buckets
+# cover the same 1µs..~2e2s range the old 28-octave ladder did; the
+# exported family names (pipeline.stage.*) are unchanged.
+_STAGE_LO, _STAGE_BUCKETS, _STAGE_SUBSTEPS = 1e-6, 112, 4
 # occupancy histograms: fill fraction 1/256 .. 1.0 in 9 log2 buckets
 _OCC_LO, _OCC_BUCKETS = 1.0 / 256, 9
 
@@ -156,6 +161,13 @@ class PipelineTelemetry:
         # pin ages, backend memory_stats cross-check — from it. None
         # restores the pre-ISSUE-8 schema exactly.
         self.ledger = None
+        # the latency SLO observatory (ISSUE 13; set by the node when
+        # broker.latency_observatory / EMQX_TPU_LATENCY is on):
+        # snapshot() derives the `latency` section — per-(qos, path)
+        # ingress→routed / ingress→delivered percentiles, SLO burn
+        # rates, breach exemplars — from it. None restores the
+        # pre-ISSUE-13 schema exactly.
+        self.observatory = None
         # slow-batch watch: a total span beyond this fires the
         # `batch.slow` hook (apps/tracer writes the log line) and counts
         # pipeline.slow_batches. None disables.
@@ -173,7 +185,8 @@ class PipelineTelemetry:
     # ---- stage spans -----------------------------------------------------
     def _stage_hist(self, stage: str):
         return self.metrics.hist(f"pipeline.stage.{stage}.seconds",
-                                 lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS)
+                                 lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS,
+                                 substeps=_STAGE_SUBSTEPS)
 
     def observe_stage(self, stage: str, seconds: float) -> None:
         self._stage_hist(stage).observe(seconds)
@@ -207,8 +220,8 @@ class PipelineTelemetry:
 
     def observe_rebuild(self, stage: str, seconds: float) -> None:
         self.metrics.hist(f"pipeline.rebuild.{stage}.seconds",
-                          lo=_STAGE_LO,
-                          n_buckets=_STAGE_BUCKETS).observe(seconds)
+                          lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS,
+                          substeps=_STAGE_SUBSTEPS).observe(seconds)
 
     # ---- columnar ingress (ISSUE 11) ------------------------------------
     def record_ingress_burst(self, rows: int) -> None:
@@ -279,8 +292,8 @@ class PipelineTelemetry:
         if is_trace:
             self.metrics.inc("pipeline.jit.compiles")
         self.metrics.hist("pipeline.jit.compile.seconds",
-                          lo=_STAGE_LO,
-                          n_buckets=_STAGE_BUCKETS).observe(dur)
+                          lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS,
+                          substeps=_STAGE_SUBSTEPS).observe(dur)
 
     # ---- snapshot (the shared schema) -----------------------------------
     def snapshot(self, full: bool = False) -> dict:
@@ -515,6 +528,16 @@ class PipelineTelemetry:
                 memory = self.ledger.section()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # latency SLO observatory (ISSUE 13): per-(qos, path)
+        # ingress→routed / ingress→delivered percentiles + the SLO
+        # burn/verdict + breach exemplars — the section bench phase
+        # rows embed and tools/latency_report.py grades offline
+        latency = {}
+        if self.observatory is not None:
+            try:
+                latency = self.observatory.section()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
         out = {
             "schema": SCHEMA,
             "stages": stages,
@@ -540,6 +563,12 @@ class PipelineTelemetry:
             out["ingress"] = ingress
         if memory or full:
             out["memory"] = memory
+        if self.observatory is not None and (latency or full):
+            # knob-off leaves NO latency section even at full=True: the
+            # A/B twin contract is "no observatory object anywhere" —
+            # unlike trace/memory, whose sections full-materialize, the
+            # latency schema simply does not exist without the knob
+            out["latency"] = latency
         jc = _jit_cache_sizes()
         if jc:
             out["jit_cache"] = jc

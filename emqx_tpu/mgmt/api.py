@@ -100,6 +100,20 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         return ledger.section()
     route("GET", "/pipeline/memory", pipeline_memory)
 
+    # ---- latency SLO observatory (ISSUE 13): the `latency` section
+    #      standalone — per-(qos, path) ingress→routed / ingress→
+    #      delivered percentiles, the SLO burn/verdict and the breach
+    #      exemplars (each linked to its window's flight-recorder
+    #      trace, triagable via /pipeline/trace) ----
+    async def pipeline_latency(_req):
+        obs = getattr(node, "latency_observatory", None)
+        if obs is None:
+            raise ApiError(404, "SERVICE_UNAVAILABLE",
+                           "latency observatory not enabled "
+                           "(EMQX_TPU_LATENCY=0?)")
+        return obs.section()
+    route("GET", "/pipeline/latency", pipeline_latency)
+
     # ---- clients ----
     async def clients(req):
         items = await mgmt.list_clients()

@@ -14,6 +14,7 @@ behavior and simpler in Python.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Optional
 
 from emqx_tpu.mqtt import constants as C
@@ -225,7 +226,7 @@ class PublishBurst:
     Channel.handle_publish_burst without per-frame Packet objects."""
 
     __slots__ = ("topics", "payloads", "qos", "retain", "dup", "pids",
-                 "props")
+                 "props", "ingress_ns")
 
     def __init__(self):
         self.topics: list[str] = []
@@ -235,6 +236,11 @@ class PublishBurst:
         self.dup: list[bool] = []
         self.pids: list[Optional[int]] = []
         self.props: list[dict] = []
+        # ingress stamp (ISSUE 13): ONE perf_counter_ns read at frame
+        # decode covers every row of the burst — per-row attribution at
+        # burst-level clock cost; the per-packet fallback stamps each
+        # Publish the same way, so the A/B ingress twins stay comparable
+        self.ingress_ns: int = 0
 
     def __len__(self) -> int:
         return len(self.topics)
@@ -272,6 +278,14 @@ class FrameParser:
                 break
             del self._buf[:consumed]
             out.append(pkt)
+        if out:
+            # ingress stamp (ISSUE 13): one clock read per feed covers
+            # every PUBLISH decoded from this read — the latency
+            # observatory's ingress→routed/delivered clock starts here
+            ns = time.perf_counter_ns()
+            for p in out:
+                if type(p) is Publish:
+                    p.ingress_ns = ns
         return out
 
     def _feed_burst(self) -> Optional[list[Packet]]:
@@ -413,6 +427,15 @@ class FrameParser:
                 break
             del self._buf[:n]
             items.append(pkt)
+        if items:
+            # ingress stamp (ISSUE 13): one clock read covers the whole
+            # columnar read — bursts carry it once for all their rows,
+            # fallback Publish frames individually (stamp-equivalent to
+            # the per-packet path by construction)
+            ns = time.perf_counter_ns()
+            for it in items:
+                if type(it) is PublishBurst or type(it) is Publish:
+                    it.ingress_ns = ns
         return items
 
     @property
