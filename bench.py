@@ -32,7 +32,8 @@ poll entirely via the relay_watcher preflight, BENCH_RELAY_PREFLIGHT=0
 restores the wait), BENCH_FANOUT (=0 skips the delivery-lane fan-out
 row; tools/fanout_bench.py knobs FANOUT_*), BENCH_INGRESS (=0 skips
 the columnar-ingress e2e twin row; tools/ingress_bench.py knobs
-INGRESS_*), BENCH_CHECKPOINT /
+INGRESS_*), BENCH_OVERLOAD (=0 skips the overload-governor drive row;
+tools/overload_bench.py knobs OVERLOAD_*), BENCH_CHECKPOINT /
 BENCH_RESUME (resumable phase ladder: each phase's JSON commits to disk
 as it completes and a restarted bench resumes from the checkpoint —
 BENCH_RESUME=0 starts fresh), BENCH_HBM (=0 skips the HBM capacity
@@ -1753,7 +1754,8 @@ def main():
     # legitimately differ between the dying run and its resume).
     knob_env = {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("BENCH_", "FANOUT_", "CHURN_",
-                                 "SKEW_", "INGRESS_", "EMQX_TPU_"))
+                                 "SKEW_", "INGRESS_", "OVERLOAD_",
+                                 "EMQX_TPU_"))
                 and k not in ("BENCH_CHECKPOINT", "BENCH_RESUME")}
     sig = {"subs": requested, "batch": B, "window": window,
            "shared_pct": shared_pct, "env": knob_env}
@@ -2226,6 +2228,45 @@ def main():
                     log(f"ingress bench failed: "
                         f"{type(e).__name__}: {e}")
                     result["ingress_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if "overload" in phases:
+                result["overload"] = phases["overload"]
+                log("overload: resumed from checkpoint")
+            elif os.environ.get("BENCH_OVERLOAD", "1") != "0":
+                # adaptive overload drive (ISSUE 14): sustained
+                # real-TCP overdrive flood, governor-on vs governor-off
+                # twins — held-SLO / shed-only-QoS0 / recovery legs
+                # graded in the row. CPU subprocess like the
+                # skew/churn/fanout/ingress rows, checkpointed the
+                # moment it completes
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    with _phase_clock("overload"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "overload_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_OVERLOAD_TIMEOUT_S", 1200)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        result["overload"] = row
+                        _ckpt_put("overload", row, sig, phases)
+                    else:
+                        result["overload_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"overload bench failed: "
+                        f"{type(e).__name__}: {e}")
+                    result["overload_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             # where the round's minutes went (ISSUE 7 satellite):
             # per-phase wall seconds + relay/backend-init wait, in the

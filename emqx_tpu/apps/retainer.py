@@ -295,6 +295,9 @@ class Retainer:
         self.storage = make_storage(storage
                                     if storage is not None
                                     else c.get("storage"))
+        # replays parked by the overload governor's defer_retained
+        # shed action (ISSUE 14), drained by tick() on recovery
+        self._deferred: list = []
 
     # ---- app lifecycle ----
     def load(self) -> "Retainer":
@@ -322,6 +325,12 @@ class Retainer:
         self._insert(msg)
         return ("ok", msg)
 
+    # overload defer_retained bound (ISSUE 14): replays parked while
+    # the governor sheds; beyond this the OLDEST parked replays drop
+    # (counted) — retained replay is best-effort convenience, and an
+    # unbounded parking lot under a flood would be its own overload
+    _DEFER_CAP = 1024
+
     def on_session_subscribed(self, clientinfo: dict, topic: str,
                               subopts: dict):
         if not self.enable:
@@ -332,6 +341,23 @@ class Retainer:
             return
         if subopts.get("share"):
             return      # shared subscriptions get no retained replay (spec)
+        gov = getattr(self.node, "overload_governor", None)
+        if gov is not None and gov.retained_deferred:
+            # overload defer_retained action (ISSUE 14): a wildcard
+            # retained read + fan-out is pure extra load mid-flood —
+            # park the replay (bounded) and run it on the first
+            # housekeeping tick after the governor recovers
+            self._deferred.append((dict(clientinfo), topic,
+                                   dict(subopts)))
+            gov.count_retained_deferred()
+            while len(self._deferred) > self._DEFER_CAP:
+                self._deferred.pop(0)
+                self.node.metrics.inc("messages.retained.dropped")
+            return
+        self._dispatch_retained(clientinfo, topic, subopts)
+
+    def _dispatch_retained(self, clientinfo: dict, topic: str,
+                           subopts: dict) -> None:
         chan = self.node.cm.lookup_channel(clientinfo.get("clientid", ""))
         if chan is None:
             return
@@ -403,8 +429,17 @@ class Retainer:
         return len(stale)
 
     def tick(self) -> None:
-        """Housekeeping hook (Node.sweep): expiry scan."""
+        """Housekeeping hook (Node.sweep): expiry scan + replay of
+        retained dispatches the overload governor deferred (runs after
+        the governor's own poll in the sweep, so the first healthy
+        tick drains the parking lot)."""
         self.clean_expired()
+        if self._deferred:
+            gov = getattr(self.node, "overload_governor", None)
+            if gov is None or not gov.retained_deferred:
+                parked, self._deferred = self._deferred, []
+                for clientinfo, topic, subopts in parked:
+                    self._dispatch_retained(clientinfo, topic, subopts)
 
     def retained_count(self) -> int:
         return len(self.storage)

@@ -87,7 +87,9 @@ class SysBroker:
         wall per class, flops/bytes where analyzed, ISSUE 8) /
         `pipeline/latency` (end-to-end latency SLO observatory:
         per-(qos, path) ingress→routed / ingress→delivered
-        percentiles, SLO burn rates, breach exemplars, ISSUE 13)."""
+        percentiles, SLO burn rates, breach exemplars, ISSUE 13) /
+        `pipeline/overload` (adaptive overload governor: grade, armed
+        shed actions, signal readings, shed counters, ISSUE 14)."""
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is None:
             return
@@ -104,7 +106,8 @@ class SysBroker:
                   json.dumps(snap["decisions"]).encode())
         for section in ("match_cache", "dedup", "readback", "rebuild",
                         "deliver", "supervise", "trace", "ingress",
-                        "memory", "program_costs", "latency"):
+                        "memory", "program_costs", "latency",
+                        "overload"):
             if section in snap:
                 self._pub(f"pipeline/{section}",
                           json.dumps(snap[section]).encode())

@@ -77,13 +77,14 @@ class Tracer:
         h.add("batch.slow", self.on_batch_slow, tag="tracer")
         h.add("pipeline.pin_stale", self.on_pin_stale, tag="tracer")
         h.add("latency.breach", self.on_latency_breach, tag="tracer")
+        h.add("overload.shed", self.on_overload_shed, tag="tracer")
         return self
 
     def unload(self) -> None:
         for hp in ("message.publish", "client.connected",
                    "client.disconnected", "session.subscribed",
                    "batch.slow", "pipeline.pin_stale",
-                   "latency.breach"):
+                   "latency.breach", "overload.shed"):
             self.node.hooks.delete(hp, "tracer")
         for t in self._traces.values():
             t.close()
@@ -197,6 +198,15 @@ class Tracer:
             except Exception:  # noqa: BLE001 — context is best-effort
                 pass
         log.warning("%s", line)
+
+    def on_overload_shed(self, info: dict) -> None:
+        """`overload.shed` hook (broker.overload, ISSUE 14): the
+        governor armed (or unwound) a shed action — or disconnected a
+        top-offender connection. One WARNING line per transition (arms
+        are grade-change-edge-triggered, never per-message), so the
+        log reads as the ladder's movement history."""
+        log.warning("OVERLOAD_SHED %s",
+                    " ".join(f"{k}={info[k]}" for k in sorted(info)))
 
     def on_pin_stale(self, info: dict) -> None:
         """`pipeline.pin_stale` hook (broker.hbm_ledger, ISSUE 8): a

@@ -120,6 +120,17 @@ class PublishBatcher:
         # window's (qos, path) attribution. None (knob off / bare test
         # nodes) restores the pre-ISSUE-13 behavior exactly.
         self.obs = getattr(node, "latency_observatory", None)
+        # overload governor (ISSUE 14): at grade critical the
+        # shed_qos0 action drops QoS0 PUBLISHes HERE, at admit — QoS1/2
+        # are never shed (at-least-once intent honored, per-session
+        # order preserved). None (knob off / bare test nodes) restores
+        # the pre-ISSUE-14 admit paths exactly. One plain attribute
+        # read per message when armed; zero reads when gov is None.
+        self.gov = getattr(node, "overload_governor", None)
+        # the most recent window's trace id (0 before any window):
+        # overload shed events land on this trace so the causal
+        # timeline shows the ladder moving between the windows
+        self.last_trace = 0
         self.window_s = window_us / 1e6
         self.max_batch = max_batch
         self.device_min_batch = device_min_batch
@@ -174,8 +185,21 @@ class PublishBatcher:
         self._consuming = False       # consumer mid-entry (fast-path gate)
 
     # ---- producer side --------------------------------------------------
+    def _shed_qos0(self, msg: Message) -> bool:
+        """ISSUE 14: True when the overload governor's shed_qos0 action
+        is armed AND this message is QoS0 — the message is dropped at
+        admit (counted; the publisher owes no ack, so nothing hangs).
+        QoS1/2 NEVER pass this gate."""
+        gov = self.gov
+        if gov is not None and gov.shed_qos0 and msg.qos == 0:
+            gov.count_qos0_shed()
+            return True
+        return False
+
     async def submit(self, msg: Message) -> int:
         """Queue one PUBLISH; resolves to its delivery count."""
+        if self._shed_qos0(msg):
+            return 0
         fut = asyncio.get_running_loop().create_future()
         self._queue.append((msg, fut))
         self._q_times.append(time.perf_counter())
@@ -187,6 +211,8 @@ class PublishBatcher:
         connection can pipeline publishes into a single batch window).
         Returns False when the queue is over the backpressure bound — the
         caller must fall back to awaiting submit()."""
+        if self._shed_qos0(msg):
+            return True      # accepted-and-shed: no fallback submit
         if len(self._queue) >= self.max_pending:
             return False
         self._queue.append((msg, None))
@@ -220,6 +246,11 @@ class PublishBatcher:
         over = len(q) + len(rows) > self.max_pending
         last = len(rows) - 1
         for i, (msg, need) in enumerate(rows):
+            if not need and self._shed_qos0(msg):
+                # ISSUE 14: QoS0 rows shed at admit never enter the
+                # queue; QoS1/2 rows (need=True) always do. Relative
+                # order of the surviving rows is the row order.
+                continue
             fut = None
             if need or (over and i == last):
                 fut = loop.create_future()
@@ -323,6 +354,7 @@ class PublishBatcher:
                         # span parents to
                         tid = rec.new_trace()
                         entry["trace"] = tid
+                        self.last_trace = tid
                         entry["root_span"] = rec.record(
                             tid, "enqueue", t_enq, now, track="batcher",
                             meta={"batch": len(batch)})

@@ -188,6 +188,16 @@ class Channel:
         # --- check: protocol version / clientid (emqx_channel check_connect)
         if pkt.proto_ver not in (C.MQTT_V3, C.MQTT_V4, C.MQTT_V5):
             return self._connack_error(C.RC_UNSUPPORTED_PROTOCOL_VERSION)
+
+        # --- overload admission gate (ISSUE 14 pause_connects action):
+        #     at grade overload+ new CONNECTs are refused with the v5
+        #     reason 0x97 (quota exceeded; the serializer downgrades
+        #     for v3/v4 clients) — the emqx_olp/esockd overload analog.
+        #     Existing sessions are untouched; recovery re-admits.
+        gov = getattr(self.node, "overload_governor", None)
+        if gov is not None and gov.connects_paused:
+            gov.count_connect_rejected()
+            return self._connack_error(C.RC_QUOTA_EXCEEDED)
         clientid = pkt.clientid
         if not clientid:
             if pkt.proto_ver < C.MQTT_V5 and not pkt.clean_start:

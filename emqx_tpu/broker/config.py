@@ -114,6 +114,14 @@ DEFAULTS: dict = {
         # (None = EMQX_TPU_SLO_ROUTE_P99_MS, then 2.0 — the ROADMAP
         # p99 < 2ms PUBLISH→route criterion; must be > 0)
         "slo_route_p99_ms": None,
+        # None = resolve via EMQX_TPU_OVERLOAD, then default-on
+        # (broker/overload.resolve_overload); false restores the
+        # pre-ISSUE-14 behavior exactly — no OverloadGovernor object,
+        # no `overload` telemetry section, REST /pipeline/overload
+        # 404, bit-identical delivery counts/order (the A/B baseline).
+        # A baked-in bool here would shadow the env knob through the
+        # defaults merge.
+        "overload": None,
         # stale-pin sentinel threshold in windows (None =
         # EMQX_TPU_PIN_WARN_WINDOWS, then 64; must be > 0): a dispatch
         # handle pinning its snapshot longer than this fires the

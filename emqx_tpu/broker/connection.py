@@ -105,6 +105,14 @@ class Connection:
             enable_alarm=cc.get("enable_alarm", False),
             min_alarm_sustain_duration=cc.get(
                 "min_alarm_sustain_duration", 60))
+        # overload governor (ISSUE 14): registered (weakly) so the
+        # critical-grade top-offender shed can rank live connections by
+        # limiter debt; shed_rows is the ingress-volume fallback score
+        # when no rate limit is configured (decayed by the governor)
+        self.shed_rows = 0.0
+        gov = getattr(node, "overload_governor", None)
+        if gov is not None:
+            gov.register_conn(self)
 
     # ---- outbound ----
     def _send_packets(self, pkts: list[P.Packet]) -> None:
@@ -119,6 +127,39 @@ class Connection:
             self._closing = reason
             if not self.writer.is_closing():
                 self.writer.close()
+
+    # overload top-offender volume floor (ISSUE 14): without a
+    # configured rate limit, a connection only qualifies for the
+    # critical-grade disconnect when its DECAYED recent row count
+    # reads as a genuine flood — a subscriber's ack stream or a
+    # moderate publisher must never rank
+    _SHED_VOLUME_FLOOR = 1000.0
+
+    # ---- overload shed (ISSUE 14: force_shutdown parity) ----
+    def shed_score(self) -> float:
+        """How much this connection is over-driving ingress: limiter
+        debt (seconds-to-repay) when a rate limit is configured — the
+        primary ranking, offset so ANY debt outranks plain volume —
+        else the decayed recent-rows count, floored so only a genuine
+        flooder qualifies. 0.0 = not a shed candidate."""
+        debt = self.limiter.debt()
+        if debt > 0:
+            return 1e6 + debt
+        if self.shed_rows >= self._SHED_VOLUME_FLOOR:
+            return self.shed_rows
+        return 0.0
+
+    def overload_disconnect(self) -> None:
+        """The governor's critical-grade disconnect: v5 clients get a
+        DISCONNECT with reason 0x97 (quota exceeded), everyone gets the
+        close — exactly the force_shutdown lifecycle, so the session
+        parks or terminates per its expiry config."""
+        self.node.metrics.inc("connection.force_shutdown")
+        if self.channel.proto_ver == C.MQTT_V5 \
+                and self.channel.conn_state == "connected":
+            self._send_packets([P.Disconnect(
+                reason_code=C.RC_QUOTA_EXCEEDED)])
+        self._request_close("overload_shed")
 
     # ---- main loop (emqx_connection:recvloop) ----
     async def run(self) -> None:
@@ -162,8 +203,16 @@ class Connection:
                     reason = f"frame_error:{e.code}"
                     self._frame_error_out(e)
                     break
-                n_rows = sum(len(it) if type(it) is PublishBurst else 1
-                             for it in items)
+                n_rows = 0
+                n_pub = 0
+                for it in items:
+                    if type(it) is PublishBurst:
+                        n_rows += len(it)
+                        n_pub += len(it)
+                    else:
+                        n_rows += 1
+                        if type(it) is P.Publish:
+                            n_pub += 1
                 if columnar and items:
                     m.inc("pipeline.ingress.bytes", len(data))
                 n_done = 0
@@ -199,6 +248,11 @@ class Connection:
                         # unless they actually block)
                         await asyncio.sleep(0)
                 if items:
+                    # offender score counts PUBLISH rows ONLY: a
+                    # subscriber's PUBACK stream (or SUBSCRIBE/PING
+                    # chatter) must never rank it for the overload
+                    # disconnect — only publish pressure does
+                    self.shed_rows += n_pub
                     await self._drain()
                     # ingress rate limit: a depleted bucket pauses reading
                     # (the {active,N}-off backpressure, emqx_connection
@@ -388,6 +442,15 @@ class Listener:
 
     def _lane_handler(self, lane: int):
         async def _on_lane_client(reader, writer):
+            gov = getattr(self.node, "overload_governor", None)
+            if lane > 0 and gov is not None and gov.connects_paused:
+                # overload pause_connects (ISSUE 14): the extra
+                # acceptor lanes stop taking connections — lane 0
+                # keeps accepting so the CONNECT still gets its v5
+                # 0x97 CONNACK (the channel-side half of this action)
+                gov.count_accept_paused()
+                writer.close()
+                return
             self.node.metrics.inc(
                 f"pipeline.ingress.lane{lane}.accepted")
             self.lane_conns[lane] += 1

@@ -163,6 +163,15 @@ class LatencyObservatory:
         self.hook_fires = 0
         self.hook_throttled = 0
         self._last_hook = 0.0
+        # overload sampling clamp (ISSUE 14): >1 records 1-in-clamp
+        # messages. Set/restored ONLY by the overload governor's
+        # clamp_sampling shed action; burn rates stay unbiased under
+        # the clamp because they are breach FRACTIONS (uniform
+        # sampling preserves a ratio).
+        self.clamp = 1
+        self._clamp_tick = 0
+        self._clamp_tick_d = 0
+        self.clamped = 0
 
     # ---- recording (event loop) -----------------------------------------
     def _h(self, leg: str, qos: int, path: str):
@@ -184,6 +193,11 @@ class LatencyObservatory:
     def record_routed(self, msg, path: str, seconds: float,
                       trace: int = 0) -> None:
         """One message's ingress→routed latency (the SLO leg)."""
+        if self.clamp > 1:
+            self._clamp_tick += 1
+            if self._clamp_tick % self.clamp:
+                self.clamped += 1
+                return
         self._h("routed", min(msg.qos, 2), path).observe(seconds)
         self.samples += 1
         sid = int(time.monotonic() / _SLOT_S)
@@ -201,6 +215,14 @@ class LatencyObservatory:
     def record_delivered(self, msg, path: str, seconds: float) -> None:
         """One message's ingress→delivered latency (route + the PR 5
         delivery-lane walk / inline delivery, settled)."""
+        if self.clamp > 1:
+            # the delivered leg keeps its OWN 1-in-N phase: deliveries
+            # settle asynchronously (lane done-callbacks), so reusing
+            # the routed tick would sample in window-sized clumps
+            # decided by whichever routed call last moved it
+            self._clamp_tick_d += 1
+            if self._clamp_tick_d % self.clamp:
+                return
         self._h("delivered", min(msg.qos, 2), path).observe(seconds)
 
     def _exemplar(self, msg, path: str, seconds: float,
@@ -227,6 +249,30 @@ class LatencyObservatory:
                 hooks.run("latency.breach", (ex,))
             else:
                 self.hook_throttled += 1
+
+    def reset(self) -> None:
+        """Zero every recorded distribution, slot and exemplar (the
+        registry histogram objects are kept and zeroed in place, so
+        exporters and cached references stay valid). Bench-phase
+        tooling only — tools/overload_bench.py resets at the
+        ramp→steady-state boundary so the graded p99 measures the
+        governed steady state, not the untimed ramp."""
+        for h in self._hist.values():
+            h.counts = [0] * len(h.counts)
+            h.count = 0
+            h.sum = 0.0
+        self._slots.clear()
+        self.samples = 0
+        self.breaches = 0
+        self.exemplars.clear()
+        # clamp/hook bookkeeping resets with the distributions: the
+        # post-reset section's clamp.skipped must describe the
+        # post-reset distribution, not the discarded ramp
+        self._clamp_tick = 0
+        self._clamp_tick_d = 0
+        self.clamped = 0
+        self.hook_fires = 0
+        self.hook_throttled = 0
 
     # ---- read side -------------------------------------------------------
     def burn_rates(self) -> dict:
@@ -312,6 +358,9 @@ class LatencyObservatory:
             "delivered": delivered,
             "slo": slo,
         }
+        if self.clamp > 1 or self.clamped:
+            out["clamp"] = {"factor": self.clamp,
+                            "skipped": self.clamped}
         if self.exemplars:
             out["exemplars"] = list(self.exemplars)
         if self.hook_fires or self.hook_throttled:

@@ -55,6 +55,17 @@ class TokenBucket:
             return True
         return False
 
+    def debt(self, now: Optional[float] = None) -> float:
+        """Outstanding debt in tokens (0 when the balance is positive):
+        how far `take()` has charged past capacity, refill applied.
+        The ISSUE-14 overload governor ranks connections by this for
+        the top-offender disconnect (force_shutdown parity) — the
+        connection with the deepest unrepaid ingress debt is the one
+        whose flood the limiter is already fighting."""
+        now = time.monotonic() if now is None else now
+        self._refill(now)
+        return max(0.0, -self.tokens)
+
     # kept for compatibility with try_take semantics
     def consume(self, n: float = 1.0,
                 now: Optional[float] = None) -> float:
@@ -90,6 +101,16 @@ class ConnectionLimiter:
         if self.bytes is not None and n_bytes:
             pause = max(pause, self.bytes.take(n_bytes))
         return pause
+
+    def debt(self) -> float:
+        """Deepest per-bucket debt in seconds-to-repay units (tokens /
+        rate) so msgs- and bytes-bucket debts compare on one scale.
+        0.0 when no bucket is configured or none is in debt."""
+        worst = 0.0
+        for bucket in (self.msgs, self.bytes):
+            if bucket is not None and bucket.rate > 0:
+                worst = max(worst, bucket.debt() / bucket.rate)
+        return worst
 
 
 class QuotaLimiter:

@@ -165,6 +165,27 @@ class Node:
                 objective_ms=perf.get("slo_route_p99_ms"))
             self.pipeline_telemetry.observatory = self.latency_observatory
             self.broker.latency_obs = self.latency_observatory
+        # adaptive overload protection (ISSUE 14): the graded load-shed
+        # ladder (normal → elevated → overload → critical) polled on
+        # the housekeeping tick, fed by signals that already exist —
+        # batcher queue/journal depth, lane backpressure, SLO burn,
+        # HBM pressure, event-loop lag — arming ordered shed actions
+        # per grade (sampling clamp → dispatch-depth shrink + retained
+        # defer + CONNECT 0x97 → QoS0 shed + top-offender disconnect).
+        # broker.overload / EMQX_TPU_OVERLOAD =0 restores the
+        # pre-ISSUE-14 behavior exactly (self.overload_governor stays
+        # None everywhere: no `overload` snapshot section, REST 404).
+        # Deliberately NOT gated on use_device: a host-only node
+        # overloads the same way (its queue/burn signals still exist).
+        self.overload_governor = None
+        from emqx_tpu.broker.overload import (OverloadGovernor,
+                                              resolve_overload)
+        if resolve_overload(perf.get("overload")):
+            self.overload_governor = OverloadGovernor(
+                self, self.metrics, hooks=self.hooks,
+                recorder=self.flight_recorder)
+            self.pipeline_telemetry.overload_state_fn = \
+                self.overload_governor.state
         # session-affine delivery lanes (ISSUE 5): the overlapped egress
         # stage both engines' consume hands plans to. 0 lanes (config
         # broker.deliver_lanes / env EMQX_TPU_DELIVER_LANES) restores
@@ -377,6 +398,12 @@ class Node:
         self.banned.tick()
         self.alarms.tick()
         self.os_mon.tick()
+        if self.overload_governor is not None:
+            # overload governor poll (ISSUE 14): grade transitions and
+            # shed arming ride the housekeeping cadence — BEFORE the
+            # app ticks, so the retainer's deferred-replay drain sees
+            # the post-recovery flags on the same tick
+            self.overload_governor.poll()
         self.stats.sample()
         for app in self._apps:
             tick = getattr(app, "tick", None)
@@ -390,6 +417,11 @@ class Node:
 
     def start_timers(self, interval: float = 1.0) -> None:
         if self._timer_task is None:
+            if self.overload_governor is not None:
+                # the loop-lag probe measures cadence drift against
+                # this interval (poll later than interval ⇒ the loop
+                # was wedged in callbacks for the difference)
+                self.overload_governor.poll_interval_s = interval
             from emqx_tpu.broker.supervise import guard_task
             self._timer_task = guard_task(
                 asyncio.ensure_future(self._housekeeping(interval)),

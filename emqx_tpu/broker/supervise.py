@@ -92,10 +92,18 @@ from typing import Callable, Optional
 
 log = logging.getLogger("emqx.supervise")
 
-# the named stage boundaries (one fault domain each)
+# the named stage boundaries (one fault domain each). The two
+# overload points (ISSUE 14) are traversed by the OverloadGovernor's
+# poll, not a pipeline stage: a fired `signal_spike` clause forces the
+# raw grade to critical for that poll, a fired `stuck_grade` clause
+# blocks grade transitions (sustained blocking raises the
+# overload_stuck alarm) — recommended kind `corrupt` (fires without
+# raising; other kinds are caught by the governor and count the same).
+# Their breakers exist but never open (no serving path notes faults
+# against them); the ladder gates ignore them.
 FAULT_POINTS = ("dispatch", "materialize", "cache_insert",
                 "overlay_apply", "lane_deliver", "snapshot_swap",
-                "mesh_exchange")
+                "mesh_exchange", "signal_spike", "stuck_grade")
 FAULT_KINDS = ("exception", "resource", "hang", "corrupt")
 
 # ladder rungs (PipelineSupervisor.rung())

@@ -161,6 +161,12 @@ class PipelineTelemetry:
         # pin ages, backend memory_stats cross-check — from it. None
         # restores the pre-ISSUE-8 schema exactly.
         self.ledger = None
+        # the overload governor's live gauges (ISSUE 14; set by the
+        # node when broker.overload / EMQX_TPU_OVERLOAD is on):
+        # snapshot() derives the `overload` section — grade, armed
+        # shed actions, last signal readings, hysteresis counters —
+        # from it. None restores the pre-ISSUE-14 schema exactly.
+        self.overload_state_fn = None
         # the latency SLO observatory (ISSUE 13; set by the node when
         # broker.latency_observatory / EMQX_TPU_LATENCY is on):
         # snapshot() derives the `latency` section — per-(qos, path)
@@ -294,6 +300,33 @@ class PipelineTelemetry:
         self.metrics.hist("pipeline.jit.compile.seconds",
                           lo=_STAGE_LO, n_buckets=_STAGE_BUCKETS,
                           substeps=_STAGE_SUBSTEPS).observe(dur)
+
+    # ---- the `overload` section (ISSUE 14) ------------------------------
+    def overload_section(self) -> dict:
+        """The standalone `overload` document: shed/reject counters +
+        the governor's live state. Shared by snapshot() and
+        `GET /api/v5/pipeline/overload` — the endpoint is polled
+        exactly when the broker is at capacity, so it must not pay
+        the full-snapshot percentile walk per request."""
+        overload: dict = {}
+        for k in ("sheds", "grade_changes", "qos0_shed",
+                  "connects_rejected", "accepts_paused",
+                  "disconnects", "retained_deferred",
+                  "stuck_polls", "rebreaches"):
+            v = self.metrics.val(f"pipeline.overload.{k}")
+            if v:
+                overload[k] = v
+        by_action = {k.rsplit(".", 1)[1]: v
+                     for k, v in self.metrics.all().items()
+                     if k.startswith("pipeline.overload.actions.")}
+        if by_action:
+            overload["actions_armed_counts"] = by_action
+        if self.overload_state_fn is not None:
+            try:
+                overload["state"] = self.overload_state_fn()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
+        return overload
 
     # ---- snapshot (the shared schema) -----------------------------------
     def snapshot(self, full: bool = False) -> dict:
@@ -528,6 +561,14 @@ class PipelineTelemetry:
                 memory = self.ledger.section()
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        # overload governor (ISSUE 14): grade + armed shed actions +
+        # signal readings (state_fn) and the pipeline.overload.*
+        # shed/reject counters — the section the overload bench and
+        # the $SYS alarm consumers read. Like `latency`, the section
+        # exists ONLY when the governor does (knob-off twin: absent
+        # even at full=True).
+        overload = self.overload_section() \
+            if self.overload_state_fn is not None else {}
         # latency SLO observatory (ISSUE 13): per-(qos, path)
         # ingress→routed / ingress→delivered percentiles + the SLO
         # burn/verdict + breach exemplars — the section bench phase
@@ -563,6 +604,10 @@ class PipelineTelemetry:
             out["ingress"] = ingress
         if memory or full:
             out["memory"] = memory
+        if self.overload_state_fn is not None and (overload or full):
+            # knob-off leaves NO overload section even at full=True:
+            # the A/B twin contract is "no governor object anywhere"
+            out["overload"] = overload
         if self.observatory is not None and (latency or full):
             # knob-off leaves NO latency section even at full=True: the
             # A/B twin contract is "no observatory object anywhere" —

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 
 from emqx_tpu.broker.supervise import spawn
+from emqx_tpu.utils.aio import timeout_after
 import base64
 import json
 import logging
@@ -111,7 +112,10 @@ class _Channel:
         # for its full timeout when this connect() fails.)
         self._fail_pending(RpcError("connection closed"))
         try:
-            async with asyncio.timeout(CONNECT_TIMEOUT):
+            # 3.10-compatible deadline (asyncio.timeout is 3.11+;
+            # utils.aio.timeout_after converts only OUR deadline
+            # cancel into TimeoutError)
+            async with timeout_after(CONNECT_TIMEOUT):
                 self.reader, self.writer = await asyncio.open_connection(
                     self.host, self.port)
                 self.writer.write(encode_frame(
@@ -217,7 +221,10 @@ class _Channel:
 
     async def cast(self, fn: str, args: list) -> None:
         try:
-            async with asyncio.timeout(CONNECT_TIMEOUT):
+            # 3.10-compatible deadline (asyncio.timeout is 3.11+;
+            # utils.aio.timeout_after converts only OUR deadline
+            # cancel into TimeoutError)
+            async with timeout_after(CONNECT_TIMEOUT):
                 await self.send({"t": "cast", "fn": fn, "args": args})
         except asyncio.TimeoutError as e:
             # a FROZEN peer stops reading: once the TCP buffers fill,

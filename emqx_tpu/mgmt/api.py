@@ -114,6 +114,24 @@ def make_api(node, mgmt: Optional[Mgmt] = None, cluster=None,
         return obs.section()
     route("GET", "/pipeline/latency", pipeline_latency)
 
+    # ---- adaptive overload governor (ISSUE 14): the `overload`
+    #      section standalone — current grade, armed shed actions,
+    #      last signal readings and the shed counters (the graded
+    #      load-shed ladder's operator surface) ----
+    async def pipeline_overload(_req):
+        gov = getattr(node, "overload_governor", None)
+        if gov is None:
+            raise ApiError(404, "SERVICE_UNAVAILABLE",
+                           "overload governor not enabled "
+                           "(EMQX_TPU_OVERLOAD=0?)")
+        tele = getattr(node, "pipeline_telemetry", None)
+        if tele is not None:
+            # the cheap standalone section — this endpoint gets polled
+            # exactly while the broker is at capacity
+            return tele.overload_section()
+        return {"state": gov.state()}
+    route("GET", "/pipeline/overload", pipeline_overload)
+
     # ---- clients ----
     async def clients(req):
         items = await mgmt.list_clients()

@@ -273,7 +273,134 @@ def grade(case: dict, twin: dict, point: str = "dispatch",
 
 # the full single-node matrix; mesh_exchange needs a multichip node and
 # rides its own test (tests/test_supervise.py::TestMeshChaos)
-MATRIX_POINTS = tuple(p for p in FAULT_POINTS if p != "mesh_exchange")
+MATRIX_POINTS = tuple(
+    p for p in FAULT_POINTS
+    # mesh_exchange needs a multichip node (own test); the ISSUE-14
+    # overload points are traversed by the governor's poll, not a
+    # pipeline stage — they ride the overload cells below
+    if p not in ("mesh_exchange", "signal_spike", "stuck_grade"))
+
+
+# ---- overload cells (ISSUE 14) -----------------------------------------
+# The governor's chaos surface: signal_spike drives a deterministic
+# grade climb → shed arming → recovery; stuck_grade freezes the ladder
+# until the clause is spent → the overload_stuck alarm. Pure poll-driven
+# (no event loop, no traffic needed), mirroring the matrix pattern:
+# run_overload_case returns the trajectory, grade_overload is the
+# oracle. Both ride tier-1 via the `chaos` marker (tests/
+# test_overload.py) like the PR 6 matrix.
+
+def _overload_node() -> Node:
+    node = build_node(lanes=0, threshold=1)
+    gov = node.overload_governor
+    assert gov is not None, "overload governor knob unexpectedly off"
+    # tight hysteresis so the cells converge in a handful of polls
+    gov.up_sustain = 1
+    gov.down_sustain = 2
+    return node
+
+
+def run_overload_case(point: str, *, count: int = 6,
+                      polls: int = 40) -> dict:
+    """Drive `polls` governor ticks with a `point:corrupt:count=N`
+    clause armed; record the grade trajectory, armed-action history,
+    alarm states and the unwind proof."""
+    node = _overload_node()
+    gov = node.overload_governor
+    sup = node.supervisor
+    sup.injector = FaultInjector(parse_faults(
+        f"{point}:corrupt:count={count}"))
+    rec = node.flight_recorder
+    sample0 = rec.sample if rec is not None else None
+    depth0 = node.publish_batcher.dispatch_depth \
+        if node.publish_batcher is not None else None
+    grades, actions_hist = [], []
+    alarm_seen = stuck_alarm_seen = False
+    if point == "stuck_grade":
+        # the stuck cell needs a PENDING transition to block: force a
+        # high raw grade from the signals themselves while the stuck
+        # clause fires
+        gov.sample_signals = lambda: {"queue_fill": 0.95}
+    for _i in range(polls):
+        gov.poll()
+        grades.append(gov.grade)
+        actions_hist.append(list(gov._armed))
+        if node.alarms.is_active("overload"):
+            alarm_seen = True
+        if node.alarms.is_active("overload_stuck"):
+            stuck_alarm_seen = True
+        if point == "stuck_grade" \
+                and all(f.fired >= f.count for f in sup.injector.faults):
+            # clause spent: let the signals recover so the (now
+            # unblocked) ladder can step down
+            gov.sample_signals = lambda: {"queue_fill": 0.0}
+    m = node.metrics
+    return {
+        "grades": grades,
+        "max_grade": max(grades),
+        "final_grade": gov.grade,
+        "actions_hist": actions_hist,
+        "final_actions": list(gov._armed),
+        "alarm_seen": alarm_seen,
+        "alarm_active": node.alarms.is_active("overload"),
+        "stuck_alarm_seen": stuck_alarm_seen,
+        "stuck_alarm_active": node.alarms.is_active("overload_stuck"),
+        "stuck_polls": m.val("pipeline.overload.stuck_polls"),
+        "sheds": m.val("pipeline.overload.sheds"),
+        "grade_changes": m.val("pipeline.overload.grade_changes"),
+        "fired": sum(f.fired for f in sup.injector.faults),
+        "sample_restored": rec is None or rec.sample == sample0,
+        "depth_restored": depth0 is None
+        or node.publish_batcher.dispatch_depth == depth0,
+        "flags_clear": not (gov.shed_qos0 or gov.connects_paused
+                            or gov.retained_deferred),
+    }
+
+
+def grade_overload(case: dict, point: str) -> list:
+    """The overload-cell oracle: violations (empty = green)."""
+    bad = []
+    if case["fired"] == 0:
+        bad.append("no armed overload clause ever fired (harness bug)")
+    if point == "signal_spike":
+        if case["max_grade"] < 3:
+            bad.append(f"spike never reached critical "
+                       f"(max grade {case['max_grade']})")
+        if case["sheds"] < 1:
+            bad.append("no shed action ever armed")
+        if not case["alarm_seen"]:
+            bad.append("overload $SYS alarm never raised")
+        if case["final_grade"] != 0:
+            bad.append(f"never recovered to normal "
+                       f"(final grade {case['final_grade']})")
+        if case["final_actions"]:
+            bad.append(f"actions not unwound: {case['final_actions']}")
+        if case["alarm_active"]:
+            bad.append("overload alarm stuck active after recovery")
+        if not (case["sample_restored"] and case["depth_restored"]
+                and case["flags_clear"]):
+            bad.append("shed side-effects not restored on recovery")
+        # the grade path must be a ladder, never a jump: adjacent
+        # grades differ by at most 1
+        for a, b in zip(case["grades"], case["grades"][1:]):
+            if abs(a - b) > 1:
+                bad.append(f"grade jumped {a}->{b}")
+                break
+    elif point == "stuck_grade":
+        if not case["stuck_alarm_seen"]:
+            bad.append("overload_stuck alarm never raised")
+        if case["stuck_polls"] < 3:
+            bad.append(f"stuck polls never accumulated "
+                       f"({case['stuck_polls']})")
+        if case["final_grade"] != 0:
+            bad.append(f"ladder never recovered once unstuck "
+                       f"(final grade {case['final_grade']})")
+        if case["stuck_alarm_active"]:
+            bad.append("overload_stuck alarm never cleared")
+    return bad
+
+
+OVERLOAD_POINTS = ("signal_spike", "stuck_grade")
 
 
 def main() -> int:
@@ -296,6 +423,18 @@ def main() -> int:
             failures += bool(bad)
             print(f"{point}:{kind}: "
                   f"{'ok' if not bad else bad}", file=sys.stderr)
+    for point in OVERLOAD_POINTS:
+        case = run_overload_case(point)
+        bad = grade_overload(case, point)
+        rows[f"overload:{point}"] = {
+            "ok": not bad, "violations": bad,
+            "max_grade": case["max_grade"],
+            "sheds": case["sheds"],
+            "grade_changes": case["grade_changes"],
+        }
+        failures += bool(bad)
+        print(f"overload:{point}: {'ok' if not bad else bad}",
+              file=sys.stderr)
     out = {
         "metric": "chaos_matrix",
         "value": len(rows) - failures,

@@ -75,7 +75,20 @@ def _fmt_leg(name: str, series: dict, out: list) -> None:
                    f"{row.get('p999_ms', 0):>10}")
 
 
-def render(name: str, lat: dict) -> str:
+def _overload_of(row: dict):
+    """The overload section riding a phase row (ISSUE 14): either
+    embedded directly (overload_bench rows) or inside the row's full
+    telemetry snapshot (e2e phase rows)."""
+    if not isinstance(row, dict):
+        return None
+    ov = row.get("overload")
+    if not isinstance(ov, dict):
+        ov = (row.get("telemetry") or {}).get("overload") \
+            if isinstance(row.get("telemetry"), dict) else None
+    return ov if isinstance(ov, dict) else None
+
+
+def render(name: str, lat: dict, overload=None) -> str:
     out = [f"== {name} =="]
     _fmt_leg("ingress→routed", lat.get("routed") or {}, out)
     _fmt_leg("ingress→delivered", lat.get("delivered") or {}, out)
@@ -87,6 +100,18 @@ def render(name: str, lat: dict) -> str:
             f"{str(slo.get('verdict', '?')).upper()}"
             f"  (samples {slo.get('samples')}, breaches "
             f"{slo.get('breaches')}, burn {slo.get('burn')})")
+    if overload:
+        # the governor's sheds NEXT TO the p99 (ISSUE 14): a tail
+        # measured while load was being shed must say so — a p99 with
+        # qos0_shed > 0 measures the governed broker, not raw capacity
+        state = overload.get("state") or {}
+        parts = [f"grade={state.get('grade', '?')}"]
+        for k in ("qos0_shed", "connects_rejected", "disconnects",
+                  "retained_deferred", "sheds", "grade_changes"):
+            v = overload.get(k)
+            if v:
+                parts.append(f"{k}={v}")
+        out.append("  overload: " + " ".join(parts))
     for ex in (lat.get("exemplars") or [])[-3:]:
         out.append(f"  exemplar: {ex.get('latency_ms')}ms "
                    f"path={ex.get('path')} qos={ex.get('qos')} "
@@ -127,7 +152,7 @@ def main(argv=None) -> int:
         if lat is None:
             missing.append(name)
             continue
-        print(render(name, lat))
+        print(render(name, lat, overload=_overload_of(row)))
         printed += 1
     if missing:
         print(f"latency_report: bench rows carry NO latency section: "
