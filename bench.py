@@ -1755,7 +1755,7 @@ def main():
     knob_env = {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("BENCH_", "FANOUT_", "CHURN_",
                                  "SKEW_", "INGRESS_", "OVERLOAD_",
-                                 "EMQX_TPU_"))
+                                 "EXCHANGE_", "EMQX_TPU_"))
                 and k not in ("BENCH_CHECKPOINT", "BENCH_RESUME")}
     sig = {"subs": requested, "batch": B, "window": window,
            "shared_pct": shared_pct, "env": knob_env}

@@ -122,6 +122,14 @@ DEFAULTS: dict = {
         # A baked-in bool here would shadow the env knob through the
         # defaults merge.
         "overload": None,
+        # None = resolve via EMQX_TPU_EXCHANGE, then default-on
+        # (parallel/serving.resolve_device_exchange); 0 restores the
+        # host gather/merge mesh readback exactly — no exchange aux
+        # tables, no exchange program, no pipeline.exchange.* traffic
+        # (the ISSUE-15 A/B baseline, bit-identical delivery counts
+        # and per-session order). A baked-in bool here would shadow
+        # the env knob through the defaults merge.
+        "device_exchange": None,
         # stale-pin sentinel threshold in windows (None =
         # EMQX_TPU_PIN_WARN_WINDOWS, then 64; must be > 0): a dispatch
         # handle pinning its snapshot longer than this fires the

@@ -220,7 +220,11 @@ class Node:
                 # accepted for config parity and surfaced in stats
                 delta_overlay=perf.get("delta_overlay"),
                 supervisor=self.supervisor,
-                dispatch_depth=dispatch_depth)
+                dispatch_depth=dispatch_depth,
+                # device-to-device exchange stage (ISSUE 15):
+                # broker.device_exchange / EMQX_TPU_EXCHANGE =0
+                # restores host gather/merge exactly
+                device_exchange=perf.get("device_exchange"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),

@@ -426,6 +426,35 @@ class PipelineTelemetry:
             readback["reduction"] = round(
                 readback["bytes_per_window_dense"]
                 / readback["bytes_per_window_compact"], 2)
+        # device-to-device exchange stage (ISSUE 15): windows served
+        # from exchanged per-dest plans vs the gather fallbacks (by
+        # reason), ring rounds, interconnect bytes, and host-landed
+        # bytes — `reduction` compares the exchange path's measured
+        # per-window landed bytes against the gather path's, the win
+        # the ISSUE-15 acceptance criterion grades, derived here once
+        # for every exporter/bench. Absent without exchange traffic
+        # (broker.device_exchange=0 leaves it exactly pre-ISSUE-15).
+        exchange = {}
+        for k in ("windows", "rounds", "bytes_exchanged",
+                  "host_landed_bytes", "overflow", "cold_class",
+                  "probe_bytes"):
+            v = self.metrics.val(f"pipeline.exchange.{k}")
+            if v:
+                exchange[k] = v
+        fb = {k.rsplit(".", 1)[1]: v
+              for k, v in self.metrics.all().items()
+              if k.startswith("pipeline.exchange.fallback.") and v}
+        if fb:
+            exchange["fallbacks"] = fb
+        xw = exchange.get("windows")
+        if xw:
+            exchange["host_landed_per_window"] = round(
+                exchange.get("host_landed_bytes", 0) / xw)
+        # deliberately NO derived reduction ratio here: in a default-on
+        # run the only gather windows are the exchange's own fallbacks
+        # (overflow/unclean — systematically the largest windows), so a
+        # same-snapshot ratio would inflate the win. The honest number
+        # is the same-traffic A/B twin in tools/sharded_bench.py.
         # rebuild machinery (ISSUE 4): stage spans + counts + compaction
         # reasons + the engine's live gauges (journal depth, overlay
         # size) — the section that makes rebuilds visible beyond the
@@ -598,6 +627,12 @@ class PipelineTelemetry:
             out["dedup"] = dedup
         if readback or full:
             out["readback"] = readback
+        if exchange:
+            # traffic-derived ONLY (never materialized at full=True):
+            # broker.device_exchange=0 increments nothing, so the
+            # section is absent exactly as pre-ISSUE-15 — the schema
+            # half of the =0-restores-exactly twin contract
+            out["exchange"] = exchange
         if trace or full:
             out["trace"] = trace
         if ingress or full:

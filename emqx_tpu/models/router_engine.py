@@ -60,6 +60,30 @@ class RouteResult(NamedTuple):
     occur: jax.Array          # [G] shared-slot occurrences this batch
 
 
+class ExchangeAux(NamedTuple):
+    """Per-shard static companions the exchange stage (ISSUE 15) needs
+    on device, stacked on the 'route' axis next to RouterTables. Built
+    once per snapshot from the same capture as the shard tables (the
+    host `_ShardBuilt` index), slice-updated by the per-shard churn
+    path exactly like the tables."""
+    seg_len: jax.Array   # [R, F_cap] int32: fan-out segment length per fid
+    fid_slow: jax.Array  # [R, F_cap] bool: rich subopts / snapshot slots
+    fid_off: jax.Array   # [R] int32: global-fid base per shard
+
+
+class ExchangeResult(NamedTuple):
+    """Output of the device-to-device exchange stage: each (dp, dest)
+    device's final delivery plan — ONLY the rows whose sessions it owns
+    (sid % R == dest), received from every source shard around the
+    'route' ring. Rows are (msg, sid, gfid | packed_opt << 24) int32
+    triples in (source shard asc, msg asc, row asc) order — the exact
+    per-session interleaving the host gather/merge path produces."""
+    plan: jax.Array      # [dp, R_dst, E, 3] int32, -1 pad
+    plan_cnt: jax.Array  # [dp, R_dst] int32 (clamped to E)
+    src_cnt: jax.Array   # [dp, R_dst, R_src] int32 segment boundaries
+    ok: jax.Array        # [dp, R] int32 bitmask: 1=msgs clean, 2=caps fit
+
+
 def post_match(subs: SubTable, mr: MatchResult, cursors: jax.Array,
                msg_hash: jax.Array, strategy: jax.Array, *,
                fanout_cap: int, slot_cap: int) -> RouteResult:
@@ -704,6 +728,15 @@ def compile_stats() -> dict[str, int]:
     # so the exported stats stay one name space at any dispatch depth
     for name, n in donating_compile_stats().items():
         out[name] = out.get(name, 0) + n
+    # the ISSUE-15 exchange programs live in parallel.sharded (one per
+    # segment-capacity class); fold them in without forcing the import
+    import sys
+    sh = sys.modules.get("emqx_tpu.parallel.sharded")
+    if sh is not None:
+        try:
+            out.update(sh.exchange_compile_stats())
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            pass
     return out
 
 

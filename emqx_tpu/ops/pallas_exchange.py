@@ -1,0 +1,106 @@
+"""Device-to-device ring rotation for the sharded exchange stage.
+
+The mesh route path (parallel/serving.py) matches per-shard on device
+but, until ISSUE 15, funneled every shard's results through host-side
+gather/merge — PR 9's stage decomposition showed that funnel is the
+wall at the SHARDED_r05 shape. The exchange stage re-keys each shard's
+matched delivery rows by their OWNING delivery shard (session-affine,
+the same ``sid % n`` discipline as the PR 5 lanes) and moves the CSR
+segments device-to-device around the 'route' ring, so each host lands
+only its own shard's final delivery plan.
+
+This module provides the one collective the exchange program needs —
+"rotate this block k positions around the ring" — in two twin
+implementations selected by backend:
+
+* ``pallas``: a `pltpu.make_async_remote_copy` kernel (SNIPPETS.md [2],
+  the worked right-permute example; /opt guide "Async Remote DMA"):
+  one RDMA per device per round, straight over the interconnect with
+  send/recv DMA semaphores. TPU only — Mosaic lowers it; exercised by
+  the slow-marked hardware smoke test.
+* ``ppermute``: `jax.lax.ppermute` with the rotation permutation — the
+  portable path XLA lowers to its collective-permute on every backend,
+  bit-identical to the kernel by construction (both are pure data
+  movement). This is what the XLA-CPU tier-1 suite and the 8-device
+  virtual-mesh oracle tests run.
+
+Selection is one function (`exchange_rotate_impl`) so the tier-1 gate
+can assert the twin wiring without touching Mosaic on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["exchange_rotate_impl", "ring_rotate"]
+
+
+def exchange_rotate_impl(backend: "str | None" = None) -> str:
+    """Which rotate twin serves this process: 'pallas' on real TPU,
+    'ppermute' everywhere else (including TPU-interpret test runs —
+    interpret-mode remote DMA is not supported, and the ppermute twin
+    is the portable oracle anyway)."""
+    backend = backend or jax.default_backend()
+    return "pallas" if backend == "tpu" else "ppermute"
+
+
+def ring_rotate(block, k: int, axis_name: str, size: int, *,
+                impl: "str | None" = None, lead_axes: tuple = ()):
+    """Rotate `block` k hops around the `axis_name` ring.
+
+    Inside a shard_map: every participant contributes its `block` and
+    receives the block held by the participant k positions to its LEFT
+    ((my - k) % size) — i.e. each device SENDS to (my + k) % size.
+    `lead_axes` names the mesh axes ahead of `axis_name` (the 'dp'
+    rows); the Pallas twin needs them to address the full logical mesh
+    coordinate of the target chip.
+    """
+    if impl is None:
+        impl = exchange_rotate_impl()
+    if impl == "pallas":
+        return _rotate_pallas(block, k, axis_name, size,
+                              lead_axes=lead_axes)
+    return jax.lax.ppermute(
+        block, axis_name, [(j, (j + k) % size) for j in range(size)])
+
+
+def _rotate_pallas(block, k: int, axis_name: str, size: int, *,
+                   lead_axes: tuple = ()):
+    """The remote-DMA twin (TPU only; lazily imports pallas so the CPU
+    tier-1 path never touches Mosaic). One kernel invocation per round:
+    copy the whole local block into the output buffer of the device
+    k positions right around the `axis_name` ring, semaphore-synced."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # jax 0.4 names these TPUMemorySpace.ANY / TPUCompilerParams; newer
+    # releases flattened them — resolve once, tolerate both
+    mem_any = getattr(pltpu, "ANY", None)
+    if mem_any is None:
+        mem_any = pltpu.TPUMemorySpace.ANY
+    params_cls = getattr(pltpu, "CompilerParams", None)
+    if params_cls is None:
+        params_cls = pltpu.TPUCompilerParams
+
+    def _kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = jax.lax.axis_index(axis_name)
+        dst = jax.lax.rem(my + k, size)
+        device_id = tuple(jax.lax.axis_index(a) for a in lead_axes) \
+            + (dst,)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=o_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        copy.start()
+        copy.wait()
+
+    call = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        in_specs=[pl.BlockSpec(memory_space=mem_any)],
+        out_specs=pl.BlockSpec(memory_space=mem_any),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+        compiler_params=params_cls(collective_id=0),
+    )
+    return call(block)

@@ -44,6 +44,7 @@ SURVEY.md §2.4 P2/P4/P6.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import zlib
@@ -60,6 +61,23 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.ops import intern as I
 from emqx_tpu.ops.compact import csr_slices
 from emqx_tpu.utils import topic as T
+
+# gfid | packed_opt << 24 is the exchange wire word: global filter ids
+# above this no longer fit next to the 6 subopt bits, so the stage
+# stands down (counted) rather than corrupting rows
+_EXCHANGE_MAX_GFID = 1 << 24
+
+
+def resolve_device_exchange(configured=None) -> bool:
+    """The one device-exchange resolution (ISSUE 15): config
+    broker.device_exchange beats EMQX_TPU_EXCHANGE beats the built-in
+    default-on. =0 restores the host gather/merge readback exactly —
+    no exchange aux tables, no exchange program, no pipeline.exchange.*
+    traffic — the A/B twin baseline the bit-identity tests pin."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_EXCHANGE", "1") \
+        not in ("0", "false", "off")
 
 
 class _ShardBuilt:
@@ -94,9 +112,11 @@ class _Handle:
     exactly like the single-chip engine's in-flight batches)."""
 
     __slots__ = ("subs", "built", "tables", "cursors", "enc", "res",
-                 "np_res", "t0", "host_idx", "trace", "sub_traces")
+                 "np_res", "t0", "host_idx", "trace", "sub_traces",
+                 "aux", "exch", "exch_bytes", "exch_fits")
 
-    def __init__(self, subs, built, tables, cursors, enc, host_idx):
+    def __init__(self, subs, built, tables, cursors, enc, host_idx,
+                 aux=None, exch_fits=True):
         self.subs = subs          # [[Message, ...]] — W=1: one sub-batch
         self.built = built        # list[_ShardBuilt] snapshot
         self.tables = tables      # stacked device pytree at prepare time
@@ -108,6 +128,10 @@ class _Handle:
         self.trace = 0            # flight-recorder window trace (ISSUE 7)
         self.sub_traces = None    # per-sub trace ids (W=1 on the mesh)
         self.t0: Optional[float] = None
+        self.aux = aux            # ExchangeAux snapshot (ISSUE 15)
+        self.exch = None          # ExchangeResult once the stage ran
+        self.exch_bytes = 0       # bytes the exchange landing cost
+        self.exch_fits = exch_fits  # snapshot's gfid-space verdict
 
 
 class ShardedRouteServer:
@@ -121,7 +145,8 @@ class ShardedRouteServer:
                  compact_readback: Optional[bool] = None,
                  delta_overlay: Optional[bool] = None,
                  supervisor=None, ledger=None,
-                 dispatch_depth: Optional[int] = None):
+                 dispatch_depth: Optional[int] = None,
+                 device_exchange: Optional[bool] = None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -213,6 +238,26 @@ class ShardedRouteServer:
         self.dispatch_depth = resolve_dispatch_depth(dispatch_depth)
         self._payload_mults = (8, 32, 128)
         self._pay_ewma: Optional[float] = None
+        # device-to-device exchange stage (ISSUE 15): after the sharded
+        # match, compact each shard's delivery rows to CSR segments
+        # keyed by owning delivery shard (sid % route — the PR 5
+        # session-affinity discipline) and ring-exchange them
+        # device-to-device (ops.pallas_exchange: remote-DMA kernel on
+        # TPU, ppermute twin elsewhere), so materialize lands ONLY the
+        # per-dest final delivery plans instead of the gathered result
+        # set. broker.device_exchange / EMQX_TPU_EXCHANGE =0 restores
+        # host gather/merge exactly. Segment capacity classes (E) ride
+        # an EWMA ladder like the CSR payload classes; a window whose
+        # rows outgrow its class falls back to host gather (counted),
+        # as does any window the clean-proof rejects (shared hit, rich
+        # fid, overflow, cluster, too-deep host_extra).
+        self.device_exchange = resolve_device_exchange(device_exchange)
+        self.aux = None                   # device ExchangeAux [R, ...]
+        self._exch_steps: dict = {}       # E -> jitted exchange program
+        self._exch_warm: set[tuple] = set()      # {(Bp, E)}
+        self._wanted_ecap: set[tuple] = set()
+        self._exch_ewma: Optional[float] = None
+        self._exch_fits = True            # global fid space < 2^24
         # combined fid->filter table across shards, memoized per
         # snapshot identity (the copy-on-write _builts list) — the
         # vectorized consume's plan hand-off indexes it
@@ -436,7 +481,80 @@ class ShardedRouteServer:
         stacked = stack_tables(tables)
         dev_tables, dev_cursors = put_sharded(
             self.mesh, stacked, np.stack(cursors), ledger=self.ledger)
-        return caps, builts, dev_tables, dev_cursors
+        aux, fits = self._build_aux(builts, caps) \
+            if self.device_exchange else (None, True)
+        return caps, builts, dev_tables, dev_cursors, aux, fits
+
+    # ---- exchange aux (ISSUE 15) ----------------------------------------
+    def _aux_host_rows(self, b: _ShardBuilt, f_cap: int):
+        """One shard's exchange companions, padded to the capacity
+        class: per-fid fan-out segment lengths + the slow mask."""
+        seg = np.zeros(f_cap, np.int32)
+        slow = np.zeros(f_cap, bool)
+        nf = len(b.fid_filter)
+        seg[:nf] = b.seg_np
+        slow[:nf] = b.fid_slow[:nf]
+        return seg, slow
+
+    @staticmethod
+    def _fid_offsets(builts) -> "tuple[np.ndarray, bool]":
+        """Global-fid base per shard — the device mirror of
+        _flat_filters' offsets (both are the cumsum of per-shard filter
+        counts in shard order, so device-packed gfids index the same
+        flat table the host consume builds). Pure: returns (offsets,
+        fits-in-packed-gfid-space); the caller adopts the verdict —
+        writing live state from here would let a superseded background
+        build override the adopted snapshot's verdict."""
+        offs = np.zeros(len(builts), np.int32)
+        total = 0
+        for r, b in enumerate(builts):
+            offs[r] = total
+            total += len(b.fid_filter)
+        return offs, total < _EXCHANGE_MAX_GFID
+
+    def _build_aux(self, builts, caps):
+        """Stack + place the exchange aux tables with the 'route'
+        sharding next to the shard tables."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from emqx_tpu.models.router_engine import ExchangeAux
+        rows = [self._aux_host_rows(b, caps["filters"]) for b in builts]
+        offs, fits = self._fid_offsets(builts)
+        spec = NamedSharding(self.mesh, P("route"))
+        # hbm: held by the adopter/caller under exchange_aux
+        aux = ExchangeAux(
+            seg_len=jax.device_put(np.stack([r[0] for r in rows]), spec),
+            fid_slow=jax.device_put(np.stack([r[1] for r in rows]), spec),
+            fid_off=jax.device_put(offs, spec))
+        return aux, fits
+
+    def _update_aux_shard(self, s: int, b: _ShardBuilt, builts):
+        """Per-shard churn twin of _build_aux: slice-update the seg/slow
+        planes (non-donating, like the tables) and re-place the tiny
+        fid_off vector, which can shift for every shard after `s` when
+        the shard's filter count changed."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from emqx_tpu.models.router_engine import ExchangeAux
+        from emqx_tpu.parallel.sharded import _apply_shard_update_keep
+        seg, slow = self._aux_host_rows(b, self._caps["filters"])
+        seg2, slow2 = _apply_shard_update_keep(
+            (self.aux.seg_len, self.aux.fid_slow), (seg, slow),
+            np.int32(s))
+        offs, fits = self._fid_offsets(builts)
+        # analysis: ok(cross-thread-state) — poll_rebuild calls this
+        # inside `with self._lock:`; the live-snapshot verdict adopts
+        # under the same lock _adopt_full_build takes (a background
+        # build's verdict instead travels in its result tuple)
+        self._exch_fits = fits
+        # hbm: held by the caller under exchange_aux
+        off_dev = jax.device_put(offs,
+                                 NamedSharding(self.mesh, P("route")))
+        return ExchangeAux(seg_len=seg2, fid_slow=slow2, fid_off=off_dev)
 
     def _hold(self, category: str, tree, owner=None):
         """Register a persistent device allocation with the HBM ledger
@@ -446,7 +564,7 @@ class ShardedRouteServer:
         return tree
 
     def _adopt_full_build(self, result, gen: int) -> bool:
-        caps, builts, dev_tables, dev_cursors = result
+        caps, builts, dev_tables, dev_cursors, aux, fits = result
         with self._lock:
             if gen <= self._adopted_gen:
                 return False    # a newer build already adopted: drop
@@ -458,9 +576,15 @@ class ShardedRouteServer:
                 # capacity classes are the jit signature: only a class
                 # change invalidates compiled batch classes — clearing
                 # on every rebuild kept the device permanently cold
-                # under subscribe churn
+                # under subscribe churn. The exchange programs trace
+                # the aux planes' filter capacity, so their warm set
+                # rides the same clock.
                 self._warm_classes.clear()
+                self._exch_warm.clear()
             self._caps = caps
+            self.aux = self._hold("exchange_aux", aux) \
+                if aux is not None else None
+            self._exch_fits = fits
         return True
 
     def _kick_full_rebuild(self) -> None:
@@ -615,6 +739,12 @@ class ShardedRouteServer:
                 builts = list(self._builts)
                 builts[s] = b
                 self._builts = builts
+                if self.aux is not None:
+                    # exchange aux rides the same per-shard update so
+                    # a handle's (tables, aux) snapshot stays coherent
+                    self.aux = self._hold(
+                        "exchange_aux",
+                        self._update_aux_shard(s, b, builts))
                 self.dirty_shards.discard(s)
         return True
 
@@ -660,9 +790,15 @@ class ShardedRouteServer:
                 # and kill the warm pass (list(set) is one atomic C call)
                 want_c = sorted({bq for bq, P in list(self._wanted_pcap)
                                  if (bq, P) not in self._compact_warm})
-                if not missing and not want_c:
+                # demand-registered exchange classes (ISSUE 15) warm the
+                # same way: re-run the step for their Bp and exchange
+                # ITS result (right shardings); same atomic list()
+                # snapshot discipline against concurrent .add()s
+                want_e = sorted({bq for bq, E in list(self._wanted_ecap)
+                                 if (bq, E) not in self._exch_warm})
+                if not missing and not want_c and not want_e:
                     return
-                self._warm_one((missing + want_c)[0])
+                self._warm_one((missing + want_c + want_e)[0])
 
         self._warm_thread = threading.Thread(target=warm, daemon=True)
         self._warm_thread.start()
@@ -678,6 +814,7 @@ class ShardedRouteServer:
                np.zeros(Bp, np.int32))
         with self._lock:
             tables, cursors, caps = self.tables, self.cursors, self._caps
+            aux = self.aux
         ctx = tele.compile_context(f"warm mesh B{Bp}") \
             if tele is not None else contextlib.nullcontext()
         with ctx:
@@ -705,14 +842,49 @@ class ShardedRouteServer:
                     payload_cap=P, match_holes=False)
                 jax.block_until_ready(cp.offsets)
             self._compact_warm.add((Bp, P))
+        # wanted exchange classes for this Bp (ISSUE 15): the exchange
+        # program compiles against the warm step's own outputs plus the
+        # live aux snapshot; keyed (Bp, E) and cleared with the caps
+        # signature (the aux planes' filter capacity is traced)
+        if aux is not None:
+            from emqx_tpu.parallel.sharded import make_exchange_step
+            for bq, E in sorted(self._wanted_ecap):
+                if bq != Bp or (Bp, E) in self._exch_warm:
+                    continue
+                fn = self._exch_steps.get(E)
+                if fn is None:
+                    fn = make_exchange_step(self.mesh, seg_cap=E)
+                    self._exch_steps[E] = fn
+                ce = tele.compile_context(f"warm mesh B{Bp}x{E}") \
+                    if tele is not None else contextlib.nullcontext()
+                with ce:
+                    ex = fn(res.matches, res.rows, res.opts,
+                            res.shared_sids, res.overflow, *aux)
+                    jax.block_until_ready(ex.plan)
+                with self._lock:
+                    if self._caps == caps:
+                        self._exch_warm.add((Bp, E))
 
     def _probe_mesh(self) -> None:
         """mesh_exchange half-open probe (ISSUE 6): run the sharded
         step warm-shaped over an all-pad batch, off the serving path —
         the same call _warm_one already makes from background threads.
+        With the exchange stage on, the probe also registers (and so
+        runs) the exchange program at the probe's batch class: the
+        domain covers the ring, and a breaker opened by a dead ring
+        must not be re-closed by a probe that never touches it.
         Raising keeps the breaker open."""
         if self._builts is None:
             return      # nothing to probe: vacuous health
+        if self.device_exchange and self.aux is not None \
+                and self._exch_fits:
+            key = (self.n_dp, self._choose_ecap(self.n_dp))
+            self._wanted_ecap.add(key)
+            # discard so _warm_one RE-RUNS the program even if the
+            # class is warm — a dead ring behind a warm class would
+            # otherwise pass the probe untraversed (the serving thread
+            # at most gathers one window as cold_class meanwhile)
+            self._exch_warm.discard(key)
         self._warm_one(self.n_dp)
 
     def max_fuse(self) -> int:
@@ -721,6 +893,7 @@ class ShardedRouteServer:
     def abandon(self, h: _Handle) -> None:
         h.res = None
         h.np_res = None
+        h.exch = None
         if self.ledger is not None:
             self.ledger.unpin(id(h))
 
@@ -773,7 +946,8 @@ class ShardedRouteServer:
             h = _Handle(subs=[msgs], built=self._builts,
                         tables=self.tables, cursors=self.cursors,
                         enc=(enc, lens, dollar, msg_hash),
-                        host_idx=host_idx)
+                        host_idx=host_idx, aux=self.aux,
+                        exch_fits=self._exch_fits)
         if self.ledger is not None:
             # pin sentinel (ISSUE 8): mesh handles pin the whole
             # stacked snapshot by reference — a leaked one holds every
@@ -817,12 +991,18 @@ class ShardedRouteServer:
             if self.sup is not None:
                 self.sup.note_fault("mesh_exchange", e)
             raise
-        if self.sup is not None:
-            self.sup.note_ok("mesh_exchange")
         with self._lock:
             if self._builts is h.built:    # no rebuild raced us
                 self.cursors = self._hold("mesh_cursors",
                                           h.res.new_cursors)
+        # the mesh_exchange domain covers the step AND the ring: the
+        # domain's ok is recorded only once both succeeded — a note_ok
+        # for the step alone would reset the breaker's consecutive-
+        # fault count right before a persistently dead ring's
+        # note_fault, and the breaker could never trip
+        exchange_faulted = self._run_exchange(h)
+        if self.sup is not None and not exchange_faulted:
+            self.sup.note_ok("mesh_exchange")
         if self.dispatch_depth > 1:
             # ISSUE 9: start the readback transfers while this thread
             # still owns the dispatch slot — materialize(W) then hides
@@ -848,11 +1028,19 @@ class ShardedRouteServer:
         if self.ledger is not None:
             self._hold("pipeline_buffers", r)
         planes = [r.overflow, r.occur]
-        Bp = int(r.matches.shape[0])
-        P = self._choose_pcap(Bp)
-        if P is None or (Bp, P) not in self._compact_warm:
-            planes += [r.matches, r.rows, r.opts, r.shared_sids,
-                       r.shared_rows, r.shared_opts]
+        if h.exch is not None:
+            # exchange windows land only the occupied plan prefix —
+            # prefetch the small control planes (ok probe, counts) ON
+            # TOP of the base overflow/occur, which the gather rung
+            # still needs if the clean-proof rejects this window; the
+            # plan slice itself is cut after the counts arrive
+            planes += [h.exch.ok, h.exch.plan_cnt, h.exch.src_cnt]
+        else:
+            Bp = int(r.matches.shape[0])
+            P = self._choose_pcap(Bp)
+            if P is None or (Bp, P) not in self._compact_warm:
+                planes += [r.matches, r.rows, r.opts, r.shared_sids,
+                           r.shared_rows, r.shared_opts]
         for a in planes:
             try:
                 a.copy_to_host_async()
@@ -885,6 +1073,134 @@ class ShardedRouteServer:
         self._pay_ewma = total if (ew is None or total > ew) \
             else 0.8 * ew + 0.2 * total
 
+    # ---- exchange stage (ISSUE 15) --------------------------------------
+    def _choose_ecap(self, Bp: int) -> int:
+        """Per-dest exchange segment capacity class for a Bp-wide
+        window: the smallest rung of a {pow2, 1.5*pow2} ladder holding
+        1.25x the peak-biased EWMA of observed per-dest row counts —
+        finer steps than the pow2-only payload ladder because every
+        padded slot here is a byte the host lands. Bounded above by the
+        everything-to-one-dest worst case: a dest's merged plan can
+        hold every source shard's full fan-out plane for its dp
+        block."""
+        b_local = max(1, Bp // self.n_dp)
+        cap_max = _next_pow2(b_local * self.fanout_cap
+                             * max(1, self.n_route))
+        ew = self._exch_ewma
+        if ew is None:
+            need = max(16, b_local // max(1, self.n_route))
+        else:
+            # class headroom over the peak-biased EWMA absorbs window-
+            # to-window variance (an undersized class overflows whole
+            # windows to gather); the padding it buys never crosses to
+            # the host — materialize lands only the occupied prefix
+            need = max(16, int(1.25 * ew) + 1)
+        E = 16
+        while E < need and E < cap_max:
+            # 16, 24, 32, 48, 64, 96, 128, ...
+            E = E * 3 // 2 if (E & (E - 1)) == 0 else E * 4 // 3
+        return min(E, cap_max)
+
+    def _note_exch(self, mx: float) -> None:
+        ew = self._exch_ewma
+        self._exch_ewma = mx if (ew is None or mx > ew) \
+            else 0.8 * ew + 0.2 * mx
+
+    def warm_exchange(self, n_msgs: int) -> bool:
+        """Blocking warm of the exchange class serving `n_msgs`-wide
+        batches (tests / bench warm-up — never the serving path, which
+        demand-registers and warms in the background)."""
+        if not self.device_exchange or self._builts is None \
+                or self.aux is None:
+            return False
+        Bp = self._batch_class(n_msgs)
+        key = (Bp, self._choose_ecap(Bp))
+        self._wanted_ecap.add(key)
+        self._warm_one(Bp)
+        return key in self._exch_warm
+
+    def _run_exchange(self, h: _Handle) -> bool:
+        """Stage 2b (executor thread, right after the route step): run
+        the device-to-device exchange program on the handle's pinned
+        (result, aux) snapshot. Every stand-down is counted, never
+        silent; a raising program degrades THIS window to host gather
+        and advances the mesh_exchange breaker — a dead ring sheds to
+        the gather rung instead of losing windows. Returns True iff
+        the program FAULTED (the caller then withholds the domain's
+        note_ok so the breaker's fault count actually accumulates);
+        stand-downs are not faults."""
+        if not self.device_exchange or h.aux is None or h.res is None:
+            return False
+        metrics = self.node.metrics
+        if not h.exch_fits:
+            # the handle's PINNED snapshot verdict, not the live one —
+            # a rebuild adopted between prepare and dispatch must not
+            # run this aux's gfids against the new verdict. Counted per
+            # stood-down WINDOW (the every-stand-down-is-counted
+            # invariant), not once per table build.
+            metrics.inc("pipeline.exchange.fallback.gfid_space")
+            return False
+        if self.broker.cluster is not None \
+                or self.broker.shared_strategy not in \
+                self._dev_strategies() \
+                or any(b.host_extra for b in h.built):
+            metrics.inc("pipeline.exchange.fallback.precluded")
+            return False
+        if h.host_idx:
+            # too-long topics route host-side per message: the device
+            # plan can't represent them, so the window gathers
+            metrics.inc("pipeline.exchange.fallback.host_idx")
+            return False
+        Bp = int(h.res.matches.shape[0])
+        E = self._choose_ecap(Bp)
+        if (Bp, E) not in self._exch_warm:
+            # target class cold: background-warm it, and meanwhile keep
+            # serving with the largest warm class that still holds the
+            # observed peak (overflow falls back per window anyway) —
+            # without this, every EWMA-driven resize would flap the
+            # whole stage back to host gather until the compile landed
+            self._wanted_ecap.add((Bp, E))
+            self._kick_class_warm()
+            ew = self._exch_ewma
+            # sorted() snapshots the set in one atomic C call — safe
+            # against the warm thread's concurrent .add()s
+            cand = [e for bq, e in sorted(self._exch_warm)
+                    if bq == Bp and (ew is None or e >= ew)]
+            if not cand:
+                metrics.inc("pipeline.exchange.cold_class")
+                return False
+            E = max(cand)
+        fn = self._exch_steps.get(E)
+        if fn is None:      # warm set says yes but builder raced: punt
+            metrics.inc("pipeline.exchange.cold_class")
+            return False
+        t0 = time.perf_counter()
+        r = h.res
+        try:
+            h.exch = fn(r.matches, r.rows, r.opts, r.shared_sids,
+                        r.overflow, *h.aux)
+        except Exception as e:  # noqa: BLE001 — degrade, don't lose
+            if self.sup is not None:
+                self.sup.note_fault("mesh_exchange", e)
+            metrics.inc("pipeline.exchange.fallback.error")
+            h.exch = None
+            return True
+        if self.ledger is not None:
+            self._hold("exchange_buffers", h.exch)
+        # bytes moved device-to-device: every device sends R-1 blocks
+        # of [E, 3] int32 around the ring (counts ride one tiny
+        # all_gather: R*4 bytes per device, included)
+        R = self.n_route
+        n_dev = self.n_dp * R
+        metrics.inc("pipeline.exchange.rounds", R - 1)
+        metrics.inc("pipeline.exchange.bytes_exchanged",
+                    n_dev * ((R - 1) * E * 12 + R * 4))
+        tele = getattr(self.node, "pipeline_telemetry", None)
+        if tele is not None:
+            tele.observe_stage("exchange", time.perf_counter() - t0)
+        self._rec_span(h.trace, "exchange", t0, track="dispatch")
+        return False
+
     def materialize(self, h: _Handle) -> None:
         """Stage 3 (executor thread): device → host readbacks.
 
@@ -899,6 +1215,13 @@ class ShardedRouteServer:
         metrics = self.node.metrics
         t0 = time.perf_counter()
         r = h.res
+        if h.exch is not None and self._materialize_exchange(h, metrics):
+            if tele is not None:
+                tele.observe_stage("materialize",
+                                   time.perf_counter() - t0)
+            self._rec_span(h.trace, "materialize", t0,
+                           track="materialize")
+            return
         Bp = int(r.matches.shape[0])
         P = self._choose_pcap(Bp)
         if P is not None and (Bp, P) not in self._compact_warm:
@@ -944,7 +1267,18 @@ class ShardedRouteServer:
                 self._rec_span(h.trace, "materialize", t0,
                                track="materialize")
                 return
-        h.np_res = {
+        h.np_res = self._dense_np_res(r)
+        metrics.inc("pipeline.readback.bytes.dense",
+                    sum(a.nbytes for a in h.np_res.values())
+                    + csr_probe_bytes)
+        metrics.inc("pipeline.readback.windows.dense")
+        if tele is not None:
+            tele.observe_stage("materialize", time.perf_counter() - t0)
+        self._rec_span(h.trace, "materialize", t0, track="materialize")
+
+    @staticmethod
+    def _dense_np_res(r) -> dict:
+        return {
             "matches": np.asarray(r.matches),
             "rows": np.asarray(r.rows), "opts": np.asarray(r.opts),
             "shared_sids": np.asarray(r.shared_sids),
@@ -953,13 +1287,96 @@ class ShardedRouteServer:
             "overflow": np.asarray(r.overflow),
             "occur": np.asarray(r.occur),      # [R, G]
         }
-        metrics.inc("pipeline.readback.bytes.dense",
-                    sum(a.nbytes for a in h.np_res.values())
-                    + csr_probe_bytes)
-        metrics.inc("pipeline.readback.windows.dense")
-        if tele is not None:
-            tele.observe_stage("materialize", time.perf_counter() - t0)
-        self._rec_span(h.trace, "materialize", t0, track="materialize")
+
+    def _fast_lane_live_ok(self, builts) -> bool:
+        """THE post-dispatch live-state guard, shared by every fast
+        lane (_consume_fast, the exchange materialize/consume): a
+        cluster, churn marks, a raced snapshot swap, a rebuild in
+        flight, a non-device strategy or too-deep filters mean the
+        snapshot-proven clean masks can no longer be trusted. One
+        predicate on purpose — a disqualifier added to one lane but
+        not the other would silently diverge the fast paths from the
+        per-message oracle. Note dirty_shards alone is NOT sufficient:
+        a rebuild clears the marks at capture while the old snapshot
+        keeps serving, and a per-shard sync update swaps the LIVE
+        builts under an in-flight handle still pinned to the old list
+        — either way the pinned fid_slow masks can miss a shared group
+        subscribed after this handle's snapshot, and those messages
+        must ride the per-message path, whose handled-set sweep checks
+        live broker.shared."""
+        broker = self.broker
+        return not (broker.cluster is not None or self.dirty_shards
+                    or builts is not self._builts
+                    or (self._rebuild_thread is not None
+                        and self._rebuild_thread.is_alive())
+                    or (self._capture_task is not None
+                        and not self._capture_task.done())
+                    or broker.shared_strategy
+                    not in self._dev_strategies()
+                    or any(b.host_extra for b in builts))
+
+    def _materialize_exchange(self, h: _Handle, metrics) -> bool:
+        """Land the exchange result if every device reported clean +
+        in-capacity; else count the reason and let the gather path land
+        this window (the dense/CSR planes are outputs of the same step
+        — transferring them is the fallback, computing them was free).
+        Returns True when the exchange plans were landed."""
+        if not self._fast_lane_live_ok(h.built):
+            # disqualified already: land dense HERE, on the executor
+            # thread, where the gather path always transfers — leaving
+            # it for finish would block the event loop on a cold
+            # multi-MB readback (the finish-time re-check below only
+            # catches the rare churn that lands after this point)
+            metrics.inc("pipeline.exchange.fallback.late")
+            return False
+        ex = h.exch
+        ok = np.asarray(ex.ok)
+        if not ok.size or int(ok.min()) != 3:
+            if ok.size and not (ok & 2).all():
+                # a segment/plan outgrew its capacity class: count it
+                # and push the EWMA past the class so the next window
+                # registers the bigger program
+                metrics.inc("pipeline.exchange.overflow")
+                cnt = np.asarray(ex.plan_cnt)
+                if cnt.size:
+                    # the true count is clamped at the class cap: bump
+                    # one ladder rung past it and let the next landed
+                    # windows' real maxima settle the EWMA
+                    self._note_exch(float(cnt.max()) * 1.25)
+            else:
+                metrics.inc("pipeline.exchange.fallback.unclean")
+            metrics.inc("pipeline.exchange.probe_bytes", ok.nbytes)
+            return False
+        cnt = np.asarray(ex.plan_cnt)
+        scnt = np.asarray(ex.src_cnt)
+        hi = int(cnt.max()) if cnt.size else 0
+        self._note_exch(float(hi))
+        # land only the occupied prefix of the plans: the class slack
+        # (E - max cnt) never crosses the device→host link. Quantized
+        # to 8 rows so the slice program set stays bounded (≤ E/8
+        # cached variants per class).
+        E = int(ex.plan.shape[2])
+        hq = min(E, max(8, -(-hi // 8) * 8))
+        plan = np.asarray(ex.plan[:, :, :hq])
+        h.np_res = {"exchange": (plan, cnt, scnt)}
+        # windows/host_landed_bytes are counted at CONSUME, once the
+        # plans actually served — a finish-time disqualifier re-lands
+        # dense, and billing this window on both paths would deflate
+        # every bytes-per-window rate built on the counters
+        h.exch_bytes = ok.nbytes + plan.nbytes + cnt.nbytes + scnt.nbytes
+        return True
+
+    def _land_dense(self, h: _Handle) -> dict:
+        """Late gather fallback (finish-time disqualifier: churn or a
+        cluster landed between dispatch and consume): transfer the
+        dense planes from the still-held device result and bill them
+        honestly as a dense readback window."""
+        np_res = self._dense_np_res(h.res)
+        self.node.metrics.inc("pipeline.exchange.fallback.late")
+        self.node.metrics.inc("pipeline.readback.bytes.dense",
+                              sum(a.nbytes for a in np_res.values()))
+        self.node.metrics.inc("pipeline.readback.windows.dense")
+        return np_res
 
     def _rec_span(self, trace_id: int, name: str, t0: float, *,
                   track: str) -> None:
@@ -1000,12 +1417,35 @@ class ShardedRouteServer:
                     plan.trace = h.sub_traces[k] \
                         if h.sub_traces and k < len(h.sub_traces) \
                         else h.trace
-        # vectorized pre-pass (ISSUE 9 satellite): one numpy sweep over
-        # the [B, route] planes serves every provably-clean message;
-        # None (global disqualifier: cluster / dirty shard / host_extra)
-        # keeps the pre-vectorized per-message path below bit-exact
-        fast = self._consume_fast(msgs, np_res, h.built, plan,
-                                  h.host_idx)
+        # exchange windows (ISSUE 15): the landed per-dest plans ARE
+        # the delivery work — consume them directly. A finish-time
+        # disqualifier (churn/cluster landed after dispatch) re-lands
+        # the dense planes from the still-held device result instead:
+        # correctness first, the bytes billed honestly.
+        fast = None
+        if np_res is not None and "exchange" in np_res:
+            fast = self._consume_exchange(msgs, np_res["exchange"],
+                                          h.built, plan)
+            if fast is None:
+                # the landed-but-unconsumed plan bytes bill as probe
+                # traffic; the window itself bills as the dense window
+                # it becomes
+                self.node.metrics.inc("pipeline.exchange.probe_bytes",
+                                      h.exch_bytes)
+                np_res = self._land_dense(h)
+                h.np_res = np_res
+            else:
+                self.node.metrics.inc("pipeline.exchange.windows")
+                self.node.metrics.inc(
+                    "pipeline.exchange.host_landed_bytes", h.exch_bytes)
+        if fast is None:
+            # vectorized pre-pass (ISSUE 9 satellite): one numpy sweep
+            # over the [B, route] planes serves every provably-clean
+            # message; None (global disqualifier: cluster / dirty
+            # shard / host_extra) keeps the pre-vectorized per-message
+            # path below bit-exact
+            fast = self._consume_fast(msgs, np_res, h.built, plan,
+                                      h.host_idx)
         counts: list[int] = []
         for i, msg in enumerate(msgs):
             if fast is not None and fast[i] is not None:
@@ -1032,7 +1472,10 @@ class ShardedRouteServer:
                             m, j, np_res, h.built))
                 continue
             counts.append(self._consume_one(msg, i, np_res, h.built))
-        self._writeback_cursors(np_res["occur"], h.built)
+        if "occur" in np_res:
+            # exchange windows skip the occur plane: clean-proof means
+            # no shared-slot occurrences, so there is nothing to mirror
+            self._writeback_cursors(np_res["occur"], h.built)
         if plan is not None:
             out = LaneCounts(counts)
             out.plan = plan
@@ -1080,24 +1523,7 @@ class ShardedRouteServer:
         unchanged). SHARDED_r05 measured the per-message Python walk at
         530 msg/s wall — this pass is what removes it."""
         broker = self.broker
-        if (broker.cluster is not None or self.dirty_shards
-                # dirty_shards alone is NOT a sufficient liveness
-                # guard: a rebuild clears the marks at capture while
-                # the old snapshot keeps serving, and a per-shard sync
-                # update swaps the LIVE builts under an in-flight
-                # handle still pinned to the old list. Either way the
-                # pinned fid_slow masks can miss a shared group
-                # subscribed after this handle's snapshot — those
-                # messages must ride the per-message path, whose
-                # handled-set sweep checks live broker.shared.
-                or builts is not self._builts
-                or (self._rebuild_thread is not None
-                    and self._rebuild_thread.is_alive())
-                or (self._capture_task is not None
-                    and not self._capture_task.done())
-                or self.broker.shared_strategy
-                not in self._dev_strategies()
-                or any(b.host_extra for b in builts)):
+        if not self._fast_lane_live_ok(builts):
             return None
         B = len(msgs)
         if B == 0:
@@ -1215,6 +1641,80 @@ class ShardedRouteServer:
                 hooks.run("message.dropped", (msgs[i],
                                               "no_subscribers"))
             out[i] = n
+        return out
+
+    def _consume_exchange(self, msgs, exch_pl, builts, plan):
+        """Consume the exchanged per-dest delivery plans (ISSUE 15).
+
+        Every message in an exchange-landed window is device-proven
+        clean, so this is the _consume_fast fast lane fed from the
+        plans instead of the gathered planes. Chunks hand to the
+        delivery lanes per SOURCE shard in ascending order — and within
+        a chunk, per dest, dp blocks ascending = global msg ascending —
+        so a session's delivery sequence is bit-identical to the
+        gather/merge walk: (src shard asc, msg asc, row asc).
+
+        Returns the per-message counts list (DEFERRED under lanes), or
+        None when a finish-time disqualifier stands (the rare churn
+        that raced in AFTER materialize's own live-state check — the
+        caller then pays one loop-side dense transfer, counted)."""
+        broker = self.broker
+        if not self._fast_lane_live_ok(builts):
+            return None
+        plan_p, _cnt_p, scnt = exch_pl
+        B = len(msgs)
+        if B == 0:
+            return []
+        R = self.n_route
+        dpn = plan_p.shape[0]
+        flat, _offs = self._flat_filters(builts)
+        starts = np.cumsum(scnt, axis=2) - scnt       # [dp, dst, src]
+        counts = np.zeros(B, np.int64)
+        delivered = 0
+        metrics = self.node.metrics
+        deliver = broker._deliver
+        if plan is not None:
+            plan.register_fast(range(B))
+        for r in range(R):
+            pieces = []
+            for d in range(R):
+                for dp in range(dpn):
+                    c = int(scnt[dp, d, r])
+                    if c:
+                        s0 = int(starts[dp, d, r])
+                        pieces.append(plan_p[dp, d, s0:s0 + c])
+            if not pieces:
+                continue
+            arr = np.concatenate(pieces) if len(pieces) > 1 \
+                else pieces[0]
+            msg_i = arr[:, 0]
+            sid = arr[:, 1]
+            w2 = arr[:, 2]
+            gfid = w2 & (_EXCHANGE_MAX_GFID - 1)
+            opt = (w2 >> 24) & 0x3F
+            if plan is not None:
+                plan.add_rows(msg_i, sid, opt, gfid, flat)
+                continue
+            for bi, s, ob, fd in zip(msg_i.tolist(), sid.tolist(),
+                                     opt.tolist(), gfid.tolist()):
+                if deliver(s, flat[fd], msgs[bi],
+                           dict(OPT_TABLE[ob & 0x3F])):
+                    counts[bi] += 1
+                    delivered += 1
+        if plan is not None:
+            return [DEFERRED] * B
+        if delivered:
+            metrics.inc("messages.routed.device", delivered)
+        hooks = broker.hooks
+        out = []
+        for i in range(B):
+            n = int(counts[i])
+            if n == 0 and not msgs[i].is_sys:
+                metrics.inc("messages.dropped")
+                metrics.inc("messages.dropped.no_subscribers")
+                hooks.run("message.dropped", (msgs[i],
+                                              "no_subscribers"))
+            out.append(n)
         return out
 
     def _collect_clean(self, msg, i: int, np_res, builts):
@@ -1503,4 +2003,11 @@ class ShardedRouteServer:
             else False,
             "payload_ewma": round(self._pay_ewma, 1)
             if self._pay_ewma is not None else None,
+            # device-to-device exchange stage (ISSUE 15): off restores
+            # host gather/merge exactly; warm classes are (Bp, E)
+            "device_exchange": bool(self.device_exchange
+                                    and self._exch_fits),
+            "exchange_warm": sorted(self._exch_warm),
+            "exchange_ewma": round(self._exch_ewma, 1)
+            if self._exch_ewma is not None else None,
         }

@@ -24,9 +24,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from emqx_tpu.models.router_engine import RouterTables, RouteResult
+from emqx_tpu.models.router_engine import (ExchangeResult, RouterTables,
+                                           RouteResult)
 from emqx_tpu.ops.fanout import fanout_normal, shared_slots
 from emqx_tpu.ops.match import match_batch
+from emqx_tpu.ops.pallas_exchange import exchange_rotate_impl, ring_rotate
 from emqx_tpu.ops.shapes import shape_match
 from emqx_tpu.ops.shared import STRATEGY_ROUND_ROBIN, pick_members
 
@@ -185,13 +187,200 @@ def make_sharded_route_step(mesh: Mesh, *, backend: str = "trie",
 
     in_specs = (table_spec, table_spec, P("dp"), P("dp"), P("dp"), P("dp"),
                 P())
+    return jax.jit(_shard_map(local_step, mesh, in_specs, out_specs))
+
+
+def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: jax>=0.6 exposes it at top level
+    with check_vma; earlier releases keep it in jax.experimental with
+    the check_rep kwarg (same semantics)."""
     if hasattr(jax, "shard_map"):
-        mapped = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False)
-    else:
-        # jax < 0.6: the API lives in jax.experimental and the
-        # replication-check kwarg is check_rep (same semantics)
-        from jax.experimental.shard_map import shard_map
-        mapped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_rep=False)
-    return jax.jit(mapped)
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+# ---- device-to-device exchange stage (ISSUE 15) -------------------------
+
+# weak refs: the registry must not pin compiled programs (and their
+# captured meshes) past their owning server's life — it exists only so
+# compile_stats can read live cache sizes
+_EXCHANGE_STEPS: dict = {}      # seq -> weakref to jitted exchange fn
+_EXCHANGE_SEQ = [0]
+
+
+def _register_exchange_step(fn) -> None:
+    import weakref
+    seq = _EXCHANGE_SEQ[0]
+    _EXCHANGE_SEQ[0] += 1
+    try:
+        _EXCHANGE_STEPS[seq] = weakref.ref(
+            fn, lambda _r, s=seq: _EXCHANGE_STEPS.pop(s, None))
+    except TypeError:           # not weakrefable on this jax: skip stats
+        pass
+
+
+def exchange_compile_stats() -> dict:
+    """Jit-cache entry counts of LIVE exchange programs, folded into
+    models.router_engine.compile_stats' recompile accounting."""
+    out: dict = {}
+    for seq, ref in sorted(_EXCHANGE_STEPS.items()):
+        fn = ref()
+        if fn is None:
+            continue
+        try:
+            out[f"exchange_step_{seq}"] = fn._cache_size()
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            pass
+    return out
+
+
+def make_exchange_step(mesh: Mesh, *, seg_cap: int,
+                       impl: "str | None" = None):
+    """Build the jitted exchange program for `mesh` ('dp', 'route').
+
+    Runs as a SECOND shard_map dispatch over the route step's result
+    planes (mesh-colocated: launch cost is microseconds — the same
+    posture as the CSR compaction's second call). Per (dp, route)
+    device it
+
+      1. flags its local messages clean/slow (capacity overflow, a
+         shared-slot hit, or a matched fid on the slow mask) and
+         psum-combines the verdict across 'route' — a message is clean
+         only if EVERY shard saw it clean;
+      2. attributes each fan-out row to its matched fid (the same
+         flat-searchsorted trick as ops.compact), packs
+         (msg, sid, gfid | opt << 24) records per OWNING delivery
+         shard (sid % R — the PR 5 session-affinity discipline) into
+         fixed-capacity segments [R, E, 3] with counted overflow;
+      3. ring-rotates the segments R-1 rounds over 'route'
+         (ops.pallas_exchange: remote-DMA kernel on TPU, ppermute twin
+         elsewhere) so device (dp, d) ends up holding exactly the rows
+         whose sessions it owns, from every source shard;
+      4. merges the received segments source-major into ONE per-dest
+         plan [E, 3] — (src asc, msg asc, row asc), the host gather
+         path's exact per-session interleaving.
+
+    Segment counts ride one tiny all_gather (control plane, 4 bytes per
+    src×dst pair); the payload moves only on the ring. `seg_cap` (E) is
+    a static capacity class — callers quantize it onto a ladder sized
+    by an EWMA of observed per-dest row counts, and a window outgrowing
+    its class reports ok&2 == 0 (the host gathers that window instead;
+    correctness never depends on the class fitting).
+
+    Call signature of the returned fn:
+      exch(matches [B,R,M], rows [B,R,F], opts [B,R,F],
+           shared_sids [B,R,K], overflow [B,R],
+           aux: ExchangeAux ([R,Fc], [R,Fc], [R])) -> ExchangeResult
+    """
+    from emqx_tpu.ops.compact import _rows_searchsorted
+    R = mesh.shape["route"]
+    E = int(seg_cap)
+    if impl is None:
+        impl = exchange_rotate_impl()
+
+    def local(matches, rows, opts, shared_sids, overflow,
+              seg_len, fid_slow, fid_off):
+        matches = matches[:, 0]            # [b, M] this shard's slice
+        rows_l = rows[:, 0]                # [b, F]
+        opts_l = opts[:, 0]
+        shared_l = shared_sids[:, 0]       # [b, K]
+        ovf_l = overflow[:, 0]             # [b]
+        seg_len_l = seg_len[0]             # [Fc]
+        fid_slow_l = fid_slow[0]
+        fid_off_l = fid_off[0]             # scalar
+        b, M = matches.shape
+        F = rows_l.shape[1]
+        my_r = jax.lax.axis_index("route")
+        my_dp = jax.lax.axis_index("dp")
+
+        # 1. clean verdict, combined across every route shard
+        valid_m = matches >= 0
+        mc = jnp.clip(matches, 0)
+        slowfid = jnp.where(valid_m, fid_slow_l[mc], False).any(-1)
+        bad_local = ovf_l | (shared_l >= 0).any(-1) | slowfid
+        bad = jax.lax.psum(bad_local.astype(jnp.int32), "route") > 0
+
+        # 2. row -> fid attribution + per-dest segment pack
+        sl = jnp.where(valid_m, seg_len_l[mc], 0).astype(jnp.int32)
+        ends = jnp.cumsum(sl, axis=-1)                        # [b, M]
+        js = jnp.broadcast_to(jnp.arange(F, dtype=jnp.int32), (b, F))
+        fidx = jnp.minimum(_rows_searchsorted(ends, js, F + 1), M - 1)
+        gfid = jnp.take_along_axis(mc, fidx, axis=-1) + fid_off_l
+        total = ends[:, -1:]
+        valid_row = (js < total) & (rows_l >= 0)
+        msg = my_dp * b + jnp.arange(b, dtype=jnp.int32)[:, None]
+        word2 = gfid | ((opts_l.astype(jnp.int32) & 0x3F) << 24)
+        dest = jnp.where(valid_row, rows_l % R, -1)
+
+        n = b * F
+        flat_dest = dest.reshape(n)
+        flat_msg = jnp.broadcast_to(msg, (b, F)).reshape(n)
+        flat_sid = rows_l.reshape(n)
+        flat_w2 = word2.reshape(n)
+        ks = jnp.arange(1, E + 1, dtype=jnp.int32)
+        slot_valid = jnp.arange(E, dtype=jnp.int32)
+        segs = []
+        cnts = []
+        pair_ovf = jnp.zeros((), bool)
+        for d in range(R):                 # static, R is small
+            m_d = flat_dest == d
+            cnt = m_d.sum(dtype=jnp.int32)
+            cum = jnp.cumsum(m_d.astype(jnp.int32))
+            pos = jnp.minimum(
+                jnp.searchsorted(cum, ks, side="left").astype(jnp.int32),
+                n - 1)
+            rec = jnp.stack([flat_msg[pos], flat_sid[pos],
+                             flat_w2[pos]], axis=-1)          # [E, 3]
+            k_ok = slot_valid < jnp.minimum(cnt, E)
+            segs.append(jnp.where(k_ok[:, None], rec, -1))
+            cnts.append(cnt)
+            pair_ovf = pair_ovf | (cnt > E)
+        seg = jnp.stack(segs)                                 # [R, E, 3]
+        cnts = jnp.stack(cnts)                                # [R]
+
+        # 3. ring rotation: after R-1 rounds, recv[s] holds the block
+        # source shard s packed for dest my_r
+        cnt_all = jax.lax.all_gather(cnts, "route")       # [R_src, R_dst]
+        own = jax.lax.dynamic_index_in_dim(seg, my_r, 0, keepdims=False)
+        recv = jax.lax.dynamic_update_index_in_dim(
+            jnp.full((R, E, 3), -1, jnp.int32), own, my_r, 0)
+        for k in range(1, R):
+            send = jax.lax.dynamic_index_in_dim(
+                seg, jax.lax.rem(my_r + k, R), 0, keepdims=False)
+            got = ring_rotate(send, k, "route", R, impl=impl,
+                              lead_axes=("dp",))
+            recv = jax.lax.dynamic_update_index_in_dim(
+                recv, got, jax.lax.rem(my_r - k + R, R), 0)
+
+        # 4. source-major merge into the per-dest delivery plan
+        src_cnt = jnp.minimum(jnp.take(cnt_all, my_r, axis=1), E)  # [R]
+        ends_s = jnp.cumsum(src_cnt)
+        starts = ends_s - src_cnt
+        tot = ends_s[-1]
+        c = jnp.arange(E, dtype=jnp.int32)
+        src_of = jnp.minimum(
+            jnp.searchsorted(ends_s, c, side="right").astype(jnp.int32),
+            R - 1)
+        plan = recv[src_of, jnp.clip(c - starts[src_of], 0, E - 1)]
+        plan_ok = c < jnp.minimum(tot, E)
+        plan = jnp.where(plan_ok[:, None], plan, -1)
+        ok = (jnp.where(bad.any(), 0, 1)
+              | jnp.where(pair_ovf | (tot > E), 0, 2)).astype(jnp.int32)
+        return ExchangeResult(
+            plan=plan[None, None],
+            plan_cnt=jnp.minimum(tot, E)[None, None],
+            src_cnt=src_cnt[None, None],
+            ok=ok[None, None])
+
+    per_dev = P("dp", "route")
+    aux_spec = P("route")
+    in_specs = (per_dev, per_dev, per_dev, per_dev, per_dev,
+                aux_spec, aux_spec, aux_spec)
+    out_specs = ExchangeResult(plan=per_dev, plan_cnt=per_dev,
+                               src_cnt=per_dev, ok=per_dev)
+    fn = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
+    _register_exchange_step(fn)
+    return fn

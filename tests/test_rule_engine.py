@@ -352,16 +352,30 @@ class TestColumnFuncsAndTopicContains:
     def test_reference_export_coverage(self):
         """Every function name exported by the reference's
         emqx_rule_funcs.erl must be callable (by registry or as a
-        column accessor)."""
+        column accessor). The export list parses live from the
+        reference tree when one is checked out at /root/reference;
+        otherwise the vendored manifest (tests/data/, captured from
+        that file) stands in, so a registry regression still fails in
+        environments without the reference sources."""
+        import os as _os
         import re as _re
 
         from emqx_tpu.rules import funcs as F
-        ref = open("/root/reference/apps/emqx_rule_engine/src/"
-                   "emqx_rule_funcs.erl").read()
+        ref_path = ("/root/reference/apps/emqx_rule_engine/src/"
+                    "emqx_rule_funcs.erl")
         names = set()
-        for block in _re.findall(r"^-export\(\[(.*?)\]\)", ref,
-                                 _re.S | _re.M):
-            names.update(_re.findall(r"([a-z_0-9]+)/\d", block))
+        if _os.path.exists(ref_path):
+            ref = open(ref_path).read()
+            for block in _re.findall(r"^-export\(\[(.*?)\]\)", ref,
+                                     _re.S | _re.M):
+                names.update(_re.findall(r"([a-z_0-9]+)/\d", block))
+        else:
+            manifest = _os.path.join(_os.path.dirname(__file__),
+                                     "data", "rule_funcs_exports.txt")
+            with open(manifest) as fh:
+                names = {ln.strip() for ln in fh
+                         if ln.strip() and not ln.startswith("#")}
+        assert names, "no reference export names found"
         covered = set(F.FUNCS) | set(F.COLUMN_FUNCS) | {"flag"}
         missing = sorted(n for n in names if n not in covered)
         assert not missing, f"uncovered reference funcs: {missing}"

@@ -124,53 +124,156 @@ def main():
 
     from emqx_tpu.broker.batcher import resolve_dispatch_depth
     depth = resolve_dispatch_depth(None)
-    rng = np.random.RandomState(11)
     n_batches = int(os.environ.get("BENCH_SHARDED_BATCHES", 40))
     # mesh warm/ready before the timed window (route_batch wait=True
     # used to do this implicitly on the first flood batch)
     eng.route_batch([make("p", 0, "dev/d0/x/n0/t", b"x")] * B,
                     wait=True)
-    disp_pool = ThreadPoolExecutor(1, thread_name_prefix="bench-disp")
-    read_pool = ThreadPoolExecutor(1, thread_name_prefix="bench-read")
-    t0 = time.time()
-    routed = 0
-    inflight: deque = deque()
+    # exchange stage (ISSUE 15): warm the segment-capacity class BEFORE
+    # any timed window, letting the EWMA ladder adapt (a cold or
+    # undersized class gathers — that would be the OLD path wearing the
+    # new name). Adaptation routes use FLOOD-SHAPED traffic: a
+    # degenerate warm batch (one hot topic) would teach the EWMA an
+    # everything-to-one-dest peak and oversize the landed plans.
+    metrics = node.metrics
+    if eng.device_exchange:
+        wrng = np.random.RandomState(7)
+        for _ in range(5):
+            before = metrics.val("pipeline.exchange.windows")
+            eng.warm_exchange(B)
+            wm = [make("p", 0, f"dev/d{i}/x/n{n}/t", b"x")
+                  for i, n in zip(wrng.randint(0, ids, B),
+                                  wrng.randint(0, nums, B))]
+            eng.route_batch(wm, wait=True)
+            if metrics.val("pipeline.exchange.windows") > before:
+                break
+        log(f"exchange warm: classes {sorted(eng._exch_warm)} "
+            f"ewma {eng._exch_ewma}")
 
-    def settle(rec):
-        nonlocal routed
-        bi, h, mat_fut = rec
-        mat_fut.result()
-        counts = eng.finish(h)
-        assert counts == [1] * B, f"batch {bi}: {counts[:8]}..."
-        routed += B
+    def run_flood(n_batches, seed=11):
+        """Pipelined oracle-checked flood; returns (msgs, wall_s)."""
+        rng = np.random.RandomState(seed)
+        disp_pool = ThreadPoolExecutor(1,
+                                       thread_name_prefix="bench-disp")
+        read_pool = ThreadPoolExecutor(1,
+                                       thread_name_prefix="bench-read")
+        t0 = time.time()
+        routed = 0
+        inflight: deque = deque()
 
-    for bi in range(n_batches):
-        i_ = rng.randint(0, ids, B)
-        n_ = rng.randint(0, nums, B)
-        msgs = [make("p", 0, f"dev/d{i}/x/n{n}/t", b"x")
-                for i, n in zip(i_, n_)]
-        while len(inflight) >= depth:
-            settle(inflight.popleft())
-        h = eng.prepare(msgs)
-        assert h is not None, f"mesh stood down at batch {bi}"
+        def settle(rec):
+            nonlocal routed
+            bi, h, mat_fut = rec
+            mat_fut.result()
+            counts = eng.finish(h)
+            assert counts == [1] * B, f"batch {bi}: {counts[:8]}..."
+            routed += B
 
-        def stages(h=h):
-            eng.dispatch(h)
-            return read_pool.submit(eng.materialize, h)
+        try:
+            for bi in range(n_batches):
+                i_ = rng.randint(0, ids, B)
+                n_ = rng.randint(0, nums, B)
+                msgs = [make("p", 0, f"dev/d{i}/x/n{n}/t", b"x")
+                        for i, n in zip(i_, n_)]
+                while len(inflight) >= depth:
+                    settle(inflight.popleft())
+                h = eng.prepare(msgs)
+                assert h is not None, f"mesh stood down at batch {bi}"
 
-        # dispatch(W+1) launches while materialize(W)/finish(W) run
-        dfut = disp_pool.submit(stages)
-        inflight.append((bi, h, _Flat(dfut)))
-    while inflight:
-        settle(inflight.popleft())
-    dt = time.time() - t0
-    disp_pool.shutdown(wait=False)
-    read_pool.shutdown(wait=False)
+                def stages(h=h):
+                    eng.dispatch(h)
+                    return read_pool.submit(eng.materialize, h)
+
+                # dispatch(W+1) launches while materialize(W)/finish(W)
+                # run
+                dfut = disp_pool.submit(stages)
+                inflight.append((bi, h, _Flat(dfut)))
+            while inflight:
+                settle(inflight.popleft())
+            dt = time.time() - t0
+        finally:
+            disp_pool.shutdown(wait=True)
+            read_pool.shutdown(wait=True)
+        return routed, dt
+
+    def _landed_snapshot():
+        return {k: metrics.val(k) for k in (
+            "pipeline.exchange.windows",
+            "pipeline.exchange.host_landed_bytes",
+            "pipeline.readback.windows.compact",
+            "pipeline.readback.bytes.compact",
+            "pipeline.readback.windows.dense",
+            "pipeline.readback.bytes.dense")}
+
+    def _landed_per_window(before, after):
+        d = {k: after[k] - before[k] for k in before}
+        xw = d["pipeline.exchange.windows"]
+        gw = d["pipeline.readback.windows.compact"] \
+            + d["pipeline.readback.windows.dense"]
+        gb = d["pipeline.readback.bytes.compact"] \
+            + d["pipeline.readback.bytes.dense"]
+        xb = d["pipeline.exchange.host_landed_bytes"]
+        total_w = xw + gw
+        return {
+            "windows_exchange": xw, "windows_gather": gw,
+            "host_landed_bytes_per_window":
+                round((xb + gb) / total_w) if total_w else None,
+        }
+
+    routed, dt = run_flood(n_batches)
     out["flood"] = {"msgs": routed, "per_s": round(routed / dt),
                     "wall_s": round(dt, 2),
                     "dispatch_depth": depth}
     log(f"flood: {routed} msgs in {dt:.1f}s = {routed / dt:.0f}/s "
         f"(depth {depth})")
+
+    # ---- 2b. exchange twin row (ISSUE 15 satellite) ------------------
+    # host-landed bytes/window + flood msgs/s, exchange on vs off, on
+    # the SAME node/state. The flood above ran with the resolved knob
+    # (default on); the twin re-floods with the stage forced off — the
+    # host gather/merge baseline. EXCHANGE_BATCHES sizes the twin
+    # floods (resume-signature relevant, like every EXCHANGE_* knob).
+    if eng.device_exchange and \
+            os.environ.get("BENCH_SHARDED_EXCHANGE", "1") != "0":
+        n_tw = int(os.environ.get("EXCHANGE_BATCHES", n_batches))
+        # the twin MUST compare identical traffic: both rows re-flood
+        # with the same seed (the main flood above used seed 11 and
+        # serves as the headline row, not the A/B)
+        s0 = _landed_snapshot()
+        r_on, dt_on = run_flood(n_tw, seed=13)
+        on_row = dict(_landed_per_window(s0, _landed_snapshot()),
+                      per_s=round(r_on / dt_on))
+        eng.device_exchange = False      # twin: host gather/merge
+        # warm the CSR compact payload class so the baseline is the
+        # established SHARDED_r05 gather path, not cold dense windows
+        wrng = np.random.RandomState(5)
+        for _ in range(100):
+            before = metrics.val("pipeline.readback.windows.compact")
+            wm = [make("p", 0, f"dev/d{i}/x/n{n}/t", b"x")
+                  for i, n in zip(wrng.randint(0, ids, B),
+                                  wrng.randint(0, nums, B))]
+            eng.route_batch(wm, wait=True)
+            if metrics.val("pipeline.readback.windows.compact") \
+                    > before:
+                break
+            time.sleep(0.05)
+        s0 = _landed_snapshot()
+        r_off, dt_off = run_flood(n_tw, seed=13)
+        off_row = dict(_landed_per_window(s0, _landed_snapshot()),
+                       per_s=round(r_off / dt_off))
+        eng.device_exchange = True
+        row = {"on": on_row, "off": off_row}
+        lb_on = on_row["host_landed_bytes_per_window"]
+        lb_off = off_row["host_landed_bytes_per_window"]
+        if lb_on and lb_off:
+            row["landed_reduction"] = round(lb_off / lb_on, 2)
+        if off_row["per_s"]:
+            row["flood_speedup"] = round(on_row["per_s"]
+                                         / off_row["per_s"], 2)
+        out["exchange"] = row
+        log(f"exchange twin: landed/window on={lb_on} off={lb_off} "
+            f"reduction={row.get('landed_reduction')} "
+            f"speedup={row.get('flood_speedup')}")
 
     # ---- 3. churn while serving --------------------------------------
     t0 = time.time()
