@@ -1610,6 +1610,17 @@ def main():
         churn_bench.main()
         return
 
+    if "--cover" in sys.argv:
+        # subscription-covering microbenchmark (ISSUE 18 acceptance:
+        # covering ON >= 2x OFF on a cover-heavy population, >= 0.95x
+        # on a uniform one, delivery counts bit-identical);
+        # full harness lives in tools/cover_bench.py
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import cover_bench
+        cover_bench.main()
+        return
+
     if "--fanout" in sys.argv:
         # high fan-out delivery microbenchmark for the delivery lanes
         # (ISSUE 5 acceptance: deliver_lanes=4 >= 2x the inline
@@ -1755,7 +1766,7 @@ def main():
     knob_env = {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("BENCH_", "FANOUT_", "CHURN_",
                                  "SKEW_", "INGRESS_", "OVERLOAD_",
-                                 "EXCHANGE_", "EMQX_TPU_"))
+                                 "EXCHANGE_", "COVER_", "EMQX_TPU_"))
                 and k not in ("BENCH_CHECKPOINT", "BENCH_RESUME")}
     sig = {"subs": requested, "batch": B, "window": window,
            "shared_pct": shared_pct, "env": knob_env}
@@ -2147,6 +2158,43 @@ def main():
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log(f"churn bench failed: {type(e).__name__}: {e}")
                     result["churn_error"] = \
+                        f"{type(e).__name__}: {str(e)[:200]}"
+            if "cover" in phases:
+                result["cover"] = phases["cover"]
+                log("cover: resumed from checkpoint")
+            elif os.environ.get("BENCH_COVER", "1") != "0":
+                # subscription-covering microbench (ISSUE 18): covering
+                # ON vs OFF matches/sec on cover-heavy + uniform
+                # populations, with the covering-set reduction factor
+                # and the counts cross-check; CPU subprocess like the
+                # skew/churn rows
+                try:
+                    senv = dict(os.environ)
+                    senv.pop("PALLAS_AXON_POOL_IPS", None)
+                    senv["JAX_PLATFORMS"] = "cpu"
+                    with _phase_clock("cover"):
+                        sp = subprocess.run(
+                            [sys.executable,
+                             os.path.join(os.path.dirname(
+                                 os.path.abspath(__file__)),
+                                 "tools", "cover_bench.py")],
+                            capture_output=True, text=True, env=senv,
+                            timeout=int(os.environ.get(
+                                "BENCH_COVER_TIMEOUT_S", 600)))
+                    row = None
+                    for ln in reversed(sp.stdout.splitlines()):
+                        if ln.strip().startswith("{"):
+                            row = json.loads(ln)
+                            break
+                    if row is not None:
+                        result["cover"] = row
+                        _ckpt_put("cover", row, sig, phases)
+                    else:
+                        result["cover_error"] = \
+                            f"rc={sp.returncode}: {sp.stderr[-200:]}"
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log(f"cover bench failed: {type(e).__name__}: {e}")
+                    result["cover_error"] = \
                         f"{type(e).__name__}: {str(e)[:200]}"
             if "fanout" in phases:
                 result["fanout"] = phases["fanout"]

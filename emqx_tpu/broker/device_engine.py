@@ -144,12 +144,26 @@ def resolve_delta_overlay(configured=None) -> bool:
         not in ("0", "false", "off")
 
 
+def resolve_subscription_covering(configured=None) -> bool:
+    """The one subscription-covering resolution: config
+    (``broker.subscription_covering``) beats ``EMQX_TPU_COVERING``
+    beats default-on. ``=0`` restores the full-set match exactly — the
+    ISSUE-18 A/B baseline (twin-tested bit-identical on delivery
+    counts and per-session order)."""
+    if configured is not None:
+        return bool(configured)
+    return os.environ.get("EMQX_TPU_COVERING", "1") \
+        not in ("0", "false", "off")
+
+
 # module-level one-shot resolutions: engines read these when their
 # config leaves a knob unset (tests monkeypatch them directly, and
-# parallel/serving.py imports the compact/delta pair for the mesh)
+# parallel/serving.py imports the compact/delta/covering set for the
+# mesh)
 _ENV_DEDUP = resolve_dedup()
 _ENV_COMPACT = resolve_compact_readback()
 _ENV_DELTA = resolve_delta_overlay()
+_ENV_COVERING = resolve_subscription_covering()
 
 
 def resolve_rebuild_threshold(configured=None) -> int:
@@ -446,12 +460,35 @@ def capture_shared(broker, f: str) -> dict:
     return out
 
 
+class _CoverState:
+    """Host-side subscription-covering companion of one snapshot
+    (ISSUE 18): the covering-set HostTrie + root encodings answer "is
+    this new filter covered?" on the subscribe path, and the numpy
+    CoverTables mirror backs the expansion-CSR APPEND region (a
+    covered new filter becomes an append + small device upload, not a
+    rebuild). n_roots/n_covered feed stats()'s reduction factor."""
+
+    __slots__ = ("trie", "root_words", "roots", "ct", "app_used",
+                 "level_cap", "n_roots", "n_covered", "incomplete")
+
+    def __init__(self, roots, ct, level_cap, n_covered, incomplete):
+        self.roots = roots            # root fid array (covering set)
+        self.trie = None              # HostTrie over roots, built
+        self.root_words = None        # lazily on the first append try
+        self.ct = ct                  # numpy CoverTables (host mirror)
+        self.app_used = 0             # append-region rows consumed
+        self.level_cap = level_cap    # vwords width (append depth gate)
+        self.n_roots = len(roots)
+        self.n_covered = n_covered
+        self.incomplete = incomplete  # detection-overflow filter count
+
+
 class _Built:
     """One compiled snapshot (host-side indexes of the device tables)."""
 
     __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_of", "slot_key",
                  "n_slots", "backend", "remote_members", "seg_np",
-                 "fid_shared", "fid_rich", "sid", "match_width")
+                 "fid_shared", "fid_rich", "sid", "match_width", "cover")
 
     def __init__(self):
         self.fid_of: dict[str, int] = {}
@@ -475,6 +512,11 @@ class _Built:
         self.seg_np = np.zeros(0, np.int64)       # seg_len as an array
         self.fid_shared = np.zeros(0, bool)       # fid has shared groups
         self.fid_rich = np.zeros(0, bool)         # fid has rich subopts
+        # subscription covering (ISSUE 18): _CoverState when this
+        # snapshot matched the covering set only, else None. With
+        # covering on, seg_np/fid_shared/fid_rich are padded to
+        # filter_cap so APPENDED fids (cover-set churn) index safely.
+        self.cover: Optional[_CoverState] = None
 
 
 class _Handle:
@@ -525,6 +567,7 @@ class DeviceRouteEngine:
                  dedup: Optional[bool] = None,
                  compact_readback: Optional[bool] = None,
                  delta_overlay: Optional[bool] = None,
+                 subscription_covering: Optional[bool] = None,
                  supervisor=None, ledger=None,
                  dispatch_depth: Optional[int] = None):
         self.node = node
@@ -619,6 +662,20 @@ class DeviceRouteEngine:
             delta_overlay = _ENV_DELTA
         self.delta_overlay = bool(delta_overlay)
         self._overlay: Optional[_Overlay] = None  # current serving table
+
+        # subscription covering (ISSUE 18 tentpole): the snapshot match
+        # tables hold only the COVERING set; a fused expansion CSR
+        # (ops/cover) re-expands matched covers after the match stage.
+        # Config beats env beats default-on; =0 builds the full set.
+        if subscription_covering is None:
+            subscription_covering = _ENV_COVERING
+        self.subscription_covering = bool(subscription_covering)
+        # new filters that could NOT ride the expansion-CSR append path
+        # (they cover others / nothing covers them): they serve through
+        # the overlay, but each one left in place erodes the covering
+        # reduction — past a budget the snapshot recompacts
+        # (_compaction_reason "covering")
+        self._cover_churn = 0
 
         # double-buffered window pipeline (ISSUE 9 tentpole): at
         # dispatch_depth >= 2 the serving dispatch (a) threads cursors
@@ -803,6 +860,8 @@ class DeviceRouteEngine:
                 self._built_deleted.discard(topic_filter)
             elif topic_filter not in self._delta_fid_of:
                 words = self._enc_filter(topic_filter)
+                if self._try_cover_append(topic_filter, words):
+                    return
                 fid = self._next_delta_fid
                 self._next_delta_fid += 1
                 self._delta_trie.insert(words, fid)
@@ -851,6 +910,115 @@ class DeviceRouteEngine:
                 self.new_slots_by_filter.setdefault(real, set()).add(group)
             # delta filters' shared groups dispatch host-side via the
             # consume sweep over live broker.shared — nothing to track
+
+    # ---- subscription covering: cover-set churn (ISSUE 18) --------------
+    def _cover_index(self, b) -> "_CoverState":
+        """The snapshot's host covering index (HostTrie over the roots
+        + their encodings), built lazily on the first append attempt —
+        the steady-state serving path never needs it, so builds don't
+        pay O(roots) host-dict construction up front."""
+        cs = b.cover
+        if cs.trie is None:
+            from emqx_tpu.ops.trie import HostTrie
+            t = HostTrie()
+            rw: dict[int, list] = {}
+            for fid in cs.roots:
+                w = self._enc_filter(b.fid_filter[int(fid)])
+                t.insert(w, int(fid))
+                rw[int(fid)] = w
+            cs.trie, cs.root_words = t, rw
+        return cs
+
+    def _try_cover_append(self, f: str, words: list) -> bool:
+        """Cover-set churn fast path: a NEW filter covered by a built
+        covering root becomes an expansion-CSR append — a spare padded
+        fid + a small device upload of the append region — instead of
+        an overlay row or a rebuild. The appended fid matches on device
+        from the next dispatch (sorted after every built filter, which
+        is exactly where the covering-off twin's overlay rows deliver)
+        and delivers host-side through the fid_rich path (its padded
+        SubTable segment is empty, so device fan-out ships nothing for
+        it). Returns False → the caller takes the overlay path, which
+        is always correct; a False on an *eligible* snapshot counts
+        toward the "covering" compaction reason (uncovered new filters
+        erode the covering reduction until a recompaction)."""
+        b = self._built
+        if b is None or b.cover is None or self._tables is None \
+                or not self.subscription_covering:
+            return False
+        m = self.node.metrics
+        cs = b.cover
+        ct = cs.ct
+        if (len(words) > cs.level_cap
+                or cs.app_used >= ct.app_root.shape[0]
+                or len(b.fid_filter) >= len(b.seg_np)):
+            self._cover_churn += 1
+            m.inc("routing.cover.append_rejects")
+            return False
+        cs = self._cover_index(b)
+        from emqx_tpu.ops.cover import host_covering_roots, rank_base
+        roots = host_covering_roots(cs.trie, cs.root_words, words,
+                                    f.startswith("$"))
+        if not roots:
+            self._cover_churn += 1
+            m.inc("routing.cover.append_rejects")
+            return False
+
+        fid = len(b.fid_filter)
+        k = cs.app_used
+        ct.app_root[k] = min(roots)
+        ct.app_fid[k] = fid
+        # dense order rank past every built filter's: appends deliver
+        # in arrival order after the snapshot set, mirroring the
+        # off-twin's overlay order (see build_cover_tables ranking)
+        ct.app_key[k] = np.int32(rank_base(ct) + k)
+        ct.app_words[k, :len(words)] = words
+        ct.app_lens[k] = len(words)
+        cs.app_used += 1
+        b.fid_of[f] = fid
+        b.fid_filter.append(f)
+        b.seg_len.append(0)
+        b.fid_rich[fid] = True       # deliver via the live broker dict
+        self._dirty_ver += 1         # hostside-mask memo must refresh
+
+        # upload ONLY the append-region leaves (same shapes → no
+        # retrace, warm classes stay valid); in-flight handles keep the
+        # old immutable arrays, so the swap is safe mid-pipeline
+        import jax
+        if b.backend == "shapes":
+            dev_cover = self._tables.shapes.cover
+        else:
+            dev_cover = self._tables.trie.cover
+        put = self._hold("cover_csr", jax.device_put(
+            (ct.app_root, ct.app_fid, ct.app_key, ct.app_words,
+             ct.app_lens)), owner=f"sid{b.sid}")
+        dev_cover = dev_cover._replace(
+            app_root=put[0], app_fid=put[1], app_key=put[2],
+            app_words=put[3], app_lens=put[4])
+        if b.backend == "shapes":
+            self._tables = self._tables._replace(
+                shapes=self._tables.shapes._replace(cover=dev_cover))
+        else:
+            self._tables = self._tables._replace(
+                trie=self._tables.trie._replace(cover=dev_cover))
+
+        # match-cache invalidation walks the EXPANDED set: cached
+        # topics that match the NEW covered filter (a member of the
+        # expanded result, never of the covering match set) must drop
+        # so their next dispatch includes the appended fid; the delta
+        # version bump keeps in-flight readbacks from re-inserting
+        # pre-append rows
+        cache = self._match_cache
+        if cache is not None:
+            from emqx_tpu.ops.delta import np_filter_match
+            cache.bump_delta_version()
+            if len(cache):
+                cache.drop_where(
+                    b.sid,
+                    lambda encs, lens, dols: np_filter_match(
+                        words, encs, lens, dols))
+        m.inc("routing.cover.appends")
+        return True
 
     # ---- snapshot compile ----------------------------------------------
     def _observe_rebuild(self, stage: str, t0: float) -> None:
@@ -1080,6 +1248,71 @@ class DeviceRouteEngine:
 
         # pow2 capacity classes: recompile only when a class grows
         filter_cap = _next_pow2(n)
+
+        # subscription covering (ISSUE 18 tentpole): detect cover
+        # relations over the interned columnar table and shrink the
+        # match set to the ROOTS (uncovered filters); the expansion CSR
+        # re-expands matched covers after the match stage (ops/cover).
+        # Disabled when nothing is covered (zero overhead, the tables
+        # stay cover-free) or when a filter is too deep for the int32
+        # order key — always correct, covering is a pure optimization.
+        from emqx_tpu.ops import cover as cover_mod
+        cover_np = None
+        cover_state = None
+        sub_ids = None                 # fids the match tables hold
+        cover_shapes = False
+        if self.subscription_covering and n >= 2 \
+                and L <= cover_mod.MAX_KEY_LEVELS:
+            dollar = np.fromiter((f.startswith("$") for f in filters),
+                                 bool, n)
+            covs, inc = cover_mod.detect_covers(rows, lens, dollar)
+            owner = cover_mod.assign_owners(covs, inc)
+            covered = np.flatnonzero(owner >= 0)
+            if len(covered):
+                # backend choice is free: the expansion stage re-sorts
+                # every candidate by the per-filter order key, and two
+                # DISTINCT filters matching the same topic always carry
+                # distinct keys (equal key + same topic forces equal
+                # literals), so the expanded row reproduces the off
+                # twin's order whatever backend matched the roots. Pick
+                # the ORDER KEY family and row width from what the off
+                # twin would run (shapes iff the FULL set fits the
+                # shape cap — its row is the full set's shape width),
+                # but match the roots under shapes whenever the ROOT
+                # subset fits: that is the covering win on populations
+                # whose full diversity overflows the shape cap into
+                # the trie
+                roots_pre = np.flatnonzero(owner < 0)
+                ns_full = cover_mod.full_shape_count(rows, lens)
+                ns_root = cover_mod.full_shape_count(
+                    rows[roots_pre], lens[roots_pre])
+                cover_shapes = L <= 20 and ns_root <= self.shape_cap
+                if cover_shapes and ns_full <= self.shape_cap:
+                    keys = cover_mod.shape_order_keys(rows, lens)
+                    out_w = 1 << max(0, (ns_full - 1).bit_length())
+                else:
+                    keys = cover_mod.trie_order_keys(rows, lens)
+                    out_w = self.match_cap
+                cand_cap = min(4096, _next_pow2(max(256, 4 * out_w)))
+                cover_np = cover_mod.build_cover_tables(
+                    rows, lens, owner, keys, fid_cap=filter_cap,
+                    out_width=out_w, cand_cap=cand_cap)
+                sub_ids = np.flatnonzero(owner < 0)
+                cover_state = _CoverState(
+                    sub_ids, cover_np, L, len(covered), int(inc.sum()))
+                # pad the consume companions to filter_cap: cover-set
+                # churn APPENDS fids past n (spare padded SubTable rows
+                # deliver host-side via fid_rich), and the consume walk
+                # indexes these arrays by matched fid
+                pad = filter_cap - n
+                b.seg_np = np.concatenate(
+                    [b.seg_np, np.zeros(pad, np.int64)])
+                b.fid_shared = np.concatenate(
+                    [b.fid_shared[:n], np.zeros(pad, bool)])
+                b.fid_rich = np.concatenate(
+                    [b.fid_rich[:n], np.zeros(pad, bool)])
+        b.cover = cover_state
+
         total_subs = sum(seg_len)
         total_members = sum(len(m) for m in shared_members.values())
         subs_tbl = build_subtable(
@@ -1090,7 +1323,33 @@ class DeviceRouteEngine:
             member_rows_cap=_next_pow2(max(1, total_members)))
 
         tables = None
-        if L <= 20:
+        if cover_np is not None:
+            # covering path: match tables over the ROOT subset, with
+            # the roots keeping their original dense fids (SubTable /
+            # fan-out CSR / consume indexing are untouched — covered
+            # fids simply never leave the match stage un-expanded)
+            roots = sub_ids
+            if cover_shapes:
+                st = build_shape_tables(rows[roots], lens[roots],
+                                        filter_ids=roots,
+                                        shape_cap=self.shape_cap)
+                tables = ShapeRouterTables(shapes=st, subs=subs_tbl)
+                b.backend = "shapes"
+                # the EXPANDED row is padded to the FULL set's shape
+                # width, so the cache/compact/consume row width matches
+                # the covering-off twin's exactly
+                b.match_width = int(cover_np.out_pad.shape[0])
+            else:
+                node_cap = _next_pow2(
+                    max(256, 2 * (int(lens[roots].sum()) + 1)))
+                trie = build_tables(rows[roots], lens[roots],
+                                    filter_ids=roots,
+                                    node_capacity=node_cap,
+                                    slot_capacity=4 * node_cap)
+                tables = RouterTables(trie=trie, subs=subs_tbl)
+                b.backend = "trie"
+                b.match_width = self.match_cap
+        if tables is None and L <= 20:
             try:
                 st = build_shape_tables(rows, lens, shape_cap=self.shape_cap)
                 tables = ShapeRouterTables(shapes=st, subs=subs_tbl)
@@ -1112,6 +1371,19 @@ class DeviceRouteEngine:
         dev_tables = self._hold("snapshot_tables", jax.device_put(tables),
                                 owner=f"sid{b.sid}")
         dev_cursors = self._hold("snapshot_cursors", jax.device_put(cur))
+        if cover_np is not None:
+            # expansion-CSR buffers ride their own ledger category
+            # ("cover_csr") so the HBM report prices covering separately
+            # from the match tables; attached post-put so the
+            # snapshot_tables category does not double-count the leaves
+            dev_cover = self._hold("cover_csr", jax.device_put(cover_np),
+                                   owner=f"sid{b.sid}")
+            if b.backend == "shapes":
+                dev_tables = dev_tables._replace(
+                    shapes=dev_tables.shapes._replace(cover=dev_cover))
+            else:
+                dev_tables = dev_tables._replace(
+                    trie=dev_tables.trie._replace(cover=dev_cover))
         return b, dev_tables, dev_cursors, rich
 
     def _hold(self, category: str, tree, owner: Optional[str] = None):
@@ -1202,6 +1474,7 @@ class DeviceRouteEngine:
         self._overlay_stale = False
         self._overlay_uncovered = 0
         self._fid_member_clock = {}
+        self._cover_churn = 0   # the fresh snapshot re-detected covers
 
     def _compaction_reason(self) -> Optional[str]:
         """Why the current snapshot should recompile, or None.
@@ -1224,6 +1497,12 @@ class DeviceRouteEngine:
         dead = len(self._built_deleted)
         if dead >= 64 and 2 * dead >= len(self._built.fid_filter):
             return "tombstones"
+        if self._cover_churn >= 64:
+            # new COVERING filters (or uncovered ones) that could not
+            # ride the expansion-CSR append path serve through the
+            # overlay; each erodes the covering reduction, so past a
+            # budget the snapshot recompacts and re-detects covers
+            return "covering"
         if self.staleness() >= self.rebuild_threshold:
             return "churn"
         return None
@@ -2338,6 +2617,14 @@ class DeviceRouteEngine:
             self.ledger.pin(id(h), h)
         self.node.metrics.inc("routing.device.windows")
         self.node.metrics.inc("routing.device.window_subs", W)
+        b = self._built
+        if b is not None and b.cover is not None:
+            # windows matched against the covering set (expansion fused
+            # after the match stage), and the per-window match-work
+            # saved: covered filters the root match never visited
+            self.node.metrics.inc("pipeline.cover.windows")
+            self.node.metrics.inc("pipeline.cover.filters_skipped",
+                                  b.cover.n_covered)
         tele = getattr(self.node, "pipeline_telemetry", None)
         if tele is not None:
             # batch occupancy per shape class: how much of the padded
@@ -3590,4 +3877,13 @@ class DeviceRouteEngine:
                         "hostfan": len(ov.hostfan)}
             if ov is not None else None,
             "journal_depth": self.journal_depth(),
+            "subscription_covering": self.subscription_covering,
+            "cover": {"roots": b.cover.n_roots,
+                      "covered": b.cover.n_covered,
+                      "appends": b.cover.app_used,
+                      "incomplete": b.cover.incomplete,
+                      "reduction": round(
+                          (b.cover.n_roots + b.cover.n_covered)
+                          / max(1, b.cover.n_roots), 2)}
+            if b is not None and b.cover is not None else None,
         }

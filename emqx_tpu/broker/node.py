@@ -224,7 +224,10 @@ class Node:
                 # device-to-device exchange stage (ISSUE 15):
                 # broker.device_exchange / EMQX_TPU_EXCHANGE =0
                 # restores host gather/merge exactly
-                device_exchange=perf.get("device_exchange"))
+                device_exchange=perf.get("device_exchange"),
+                # subscription covering A/B knob (ISSUE 18; None =
+                # EMQX_TPU_COVERING / default-on)
+                subscription_covering=perf.get("subscription_covering"))
             self.publish_batcher = PublishBatcher(
                 self, self.device_engine,
                 window_us=perf.get("batch_window_us", 200),
@@ -249,6 +252,9 @@ class Node:
                 # delta-overlay A/B knob (ISSUE 4; None =
                 # EMQX_TPU_DELTA_OVERLAY / default-on)
                 delta_overlay=perf.get("delta_overlay"),
+                # subscription covering A/B knob (ISSUE 18; None =
+                # EMQX_TPU_COVERING / default-on)
+                subscription_covering=perf.get("subscription_covering"),
                 supervisor=self.supervisor,
                 dispatch_depth=dispatch_depth)
             self.publish_batcher = PublishBatcher(

@@ -120,7 +120,18 @@ def match_batch(tables: TrieTables, topics: jax.Array, lens: jax.Array,
         step, (frontier0, out0, count0, oflow0), (steps, words_t))
 
     oflow = oflow | (count > M)
-    return MatchResult(matches=out, counts=jnp.minimum(count, M), overflow=oflow)
+    mr = MatchResult(matches=out, counts=jnp.minimum(count, M),
+                     overflow=oflow)
+    if tables.cover is not None:
+        # subscription covering: the trie held the covering set only —
+        # re-expand matched covers into the exact full-set row (fused
+        # CSR gather + verify + order-key sort; ops/cover). Trace-time
+        # branch: cover-carrying snapshots are a distinct pytree
+        # structure, so covering-off programs are byte-identical to
+        # before.
+        from emqx_tpu.ops.cover import cover_expand
+        mr = cover_expand(tables.cover, mr, topics, lens, is_dollar)
+    return mr
 
 
 def merge_match_results(base_matches: jax.Array, base_counts: jax.Array,

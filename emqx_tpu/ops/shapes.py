@@ -77,6 +77,12 @@ class ShapeTables(NamedTuple):
     buckets: np.ndarray
     n_shapes: np.ndarray
     n_filters: np.ndarray
+    # optional subscription-covering expansion state (ops/cover): when
+    # present the buckets hold the COVERING set only and shape_match
+    # re-expands matched covers into the exact full-set result, padded
+    # to the FULL set's shape width (cover.out_pad) so the covering-off
+    # twin's match_width is preserved. None = empty pytree node.
+    cover: Optional[NamedTuple] = None
 
 
 class ShapeCapacityError(ValueError):
@@ -525,7 +531,21 @@ def shape_match(st: ShapeTables, topics: jax.Array, lens: jax.Array,
                                                   is_dollar)
     else:
         h1, h2, b1, b2, compatible = _fold_xla(st, topics, lens, is_dollar)
-    return _probe_buckets(st, h1, h2, b1, b2, compatible)
+    mr = _probe_buckets(st, h1, h2, b1, b2, compatible)
+    return _cover_expand_maybe(st, mr, topics, lens, is_dollar)
+
+
+def _cover_expand_maybe(st: ShapeTables, mr: MatchResult, topics, lens,
+                        is_dollar) -> MatchResult:
+    """Subscription covering: when the tables carry cover state, the
+    buckets held the covering set only — re-expand matched covers into
+    the exact full-set row (fused CSR gather + verify + order-key sort,
+    ops/cover). Trace-time branch: covering-off snapshots have a
+    different pytree structure, so their programs are unchanged."""
+    if st.cover is None:
+        return mr
+    from emqx_tpu.ops.cover import cover_expand
+    return cover_expand(st.cover, mr, topics, lens, is_dollar)
 
 
 @jax.jit
@@ -535,4 +555,5 @@ def shape_match_pallas(st: ShapeTables, topics: jax.Array,
     """shape_match with the fold stage as a fused Pallas kernel
     (ops/pallas_fold.py); bit-identical results by construction."""
     h1, h2, b1, b2, compat = _fold_pallas(st, topics, lens, is_dollar)
-    return _probe_buckets(st, h1, h2, b1, b2, compat)
+    mr = _probe_buckets(st, h1, h2, b1, b2, compat)
+    return _cover_expand_maybe(st, mr, topics, lens, is_dollar)

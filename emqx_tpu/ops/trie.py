@@ -37,6 +37,10 @@ class TrieTables(NamedTuple):
     plus_child/hash_child: wildcard branch per node, -1 = none.
     node_filter: terminal filter id per node, -1 = none.
     num_nodes/num_edges: scalars (informational; capacities come from shapes).
+    cover: optional subscription-covering expansion state (ops/cover):
+      when present the trie holds the COVERING set only and match_batch
+      re-expands matched covers into the exact full-set result. None is
+      an empty pytree node, so existing snapshots are unaffected.
     """
 
     slot_parent: np.ndarray  # [S]
@@ -47,6 +51,7 @@ class TrieTables(NamedTuple):
     node_filter: np.ndarray  # [N]
     num_nodes: np.ndarray    # []
     num_edges: np.ndarray    # []
+    cover: Optional[NamedTuple] = None  # CoverTables (ops/cover)
 
 
 def mix_hash(parent, word):

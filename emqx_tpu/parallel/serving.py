@@ -84,9 +84,15 @@ class _ShardBuilt:
     """Host index of one shard's compiled tables."""
 
     __slots__ = ("fid_of", "fid_filter", "seg_len", "slot_key", "rich",
-                 "host_extra", "remote_members", "seg_np", "fid_slow")
+                 "host_extra", "remote_members", "seg_np", "fid_slow",
+                 "cover_roots", "cover_covered")
 
     def __init__(self):
+        # subscription covering (ISSUE 18): per-shard detection counters
+        # for stats(); roots == len(fid_filter) when covering found
+        # nothing (identity expansion)
+        self.cover_roots = 0
+        self.cover_covered = 0
         self.fid_of: dict[str, int] = {}
         self.fid_filter: list[str] = []
         self.seg_len: list[int] = []
@@ -146,7 +152,8 @@ class ShardedRouteServer:
                  delta_overlay: Optional[bool] = None,
                  supervisor=None, ledger=None,
                  dispatch_depth: Optional[int] = None,
-                 device_exchange: Optional[bool] = None):
+                 device_exchange: Optional[bool] = None,
+                 subscription_covering: Optional[bool] = None):
         from emqx_tpu.parallel.mesh import make_mesh
         self.node = node
         self.broker = node.broker
@@ -223,6 +230,19 @@ class ShardedRouteServer:
             from emqx_tpu.broker.device_engine import _ENV_DELTA
             delta_overlay = _ENV_DELTA
         self.delta_overlay = bool(delta_overlay)
+        # subscription covering (ISSUE 18), mesh edition: each shard's
+        # trie holds only its local COVERING set and the per-shard
+        # expansion CSR re-expands after the match stage — INSIDE
+        # match_batch, so the exchange ships already-expanded rows and
+        # the aggregation per filter-hash shard needs no new step. When
+        # on, EVERY shard carries cover tables (empty ones where the
+        # shard has no covered filters) so the stacked pytree stays
+        # uniform; cover-set churn rides the existing per-shard
+        # incremental rebuild (which re-detects covers for that shard).
+        if subscription_covering is None:
+            from emqx_tpu.broker.device_engine import _ENV_COVERING
+            subscription_covering = _ENV_COVERING
+        self.subscription_covering = bool(subscription_covering)
         # double-buffered window pipeline (ISSUE 9): the mesh gains the
         # same prepare/materialize split as the single-chip engine — at
         # dispatch_depth >= 2 the batcher runs up to that many windows'
@@ -425,9 +445,52 @@ class ShardedRouteServer:
         for fid in filter_slots:
             b.fid_slow[fid] = True
 
-        trie = build_tables(rows[:len(mine)], lens,
-                            node_capacity=caps["nodes"],
-                            slot_capacity=4 * caps["nodes"])
+        # subscription covering (ISSUE 18): detect cover relations among
+        # this shard's filters, compile the trie over the COVERING set
+        # only, and attach the expansion CSR so match_batch re-expands
+        # matched covers into the exact full-set row BEFORE the exchange
+        # ships it. The stacked mesh pytree must be structurally uniform
+        # across shards and across incremental rebuilds, so the knob
+        # alone decides attachment: when on, every shard carries cover
+        # tables — an identity CSR (every filter its own root) where the
+        # shard has no cover relations. Uniform constants (match_cap out
+        # width, 256-candidate verify lane, caps["filters"] verify rows,
+        # 1-row append region — mesh churn rides the per-shard rebuild,
+        # not the append path) keep shard slices stack/update-compatible.
+        cover_np = None
+        roots = None
+        if self.subscription_covering:
+            from emqx_tpu.ops import cover as cover_mod
+            if L <= cover_mod.MAX_KEY_LEVELS:
+                n = len(mine)
+                dollar = np.fromiter(
+                    (f.startswith("$") for f in b.fid_filter), bool, n)
+                if n >= 2:
+                    covers, inc = cover_mod.detect_covers(
+                        rows[:n], lens, dollar)
+                    owner = cover_mod.assign_owners(covers, inc)
+                else:
+                    owner = np.full(n, -1, np.int64)
+                keys = cover_mod.trie_order_keys(rows[:n], lens)
+                cover_np = cover_mod.build_cover_tables(
+                    rows[:n], lens, owner, keys,
+                    fid_cap=caps["filters"], out_width=self.match_cap,
+                    cand_cap=256, verify_cap=caps["filters"],
+                    append_cap=1)
+                roots = np.flatnonzero(owner < 0).astype(np.int64)
+                b.cover_roots = int(roots.size)
+                b.cover_covered = n - int(roots.size)
+
+        if cover_np is not None:
+            trie = build_tables(rows[roots], lens[roots],
+                                filter_ids=roots,
+                                node_capacity=caps["nodes"],
+                                slot_capacity=4 * caps["nodes"])
+            trie = trie._replace(cover=cover_np)
+        else:
+            trie = build_tables(rows[:len(mine)], lens,
+                                node_capacity=caps["nodes"],
+                                slot_capacity=4 * caps["nodes"])
         subs_tbl = build_subtable(
             caps["filters"], {k: v for k, v in normal.items()},
             filter_slots, shared_members,
@@ -2010,4 +2073,14 @@ class ShardedRouteServer:
             "exchange_warm": sorted(self._exch_warm),
             "exchange_ewma": round(self._exch_ewma, 1)
             if self._exch_ewma is not None else None,
+            # subscription covering (ISSUE 18): per-shard detection,
+            # aggregated; reduction = full set / covering set
+            "subscription_covering": self.subscription_covering,
+            "cover": {
+                "roots": (nr := sum(b.cover_roots
+                                    for b in self._builts or ())),
+                "covered": (nc := sum(b.cover_covered
+                                      for b in self._builts or ())),
+                "reduction": round((nr + nc) / max(1, nr), 2),
+            } if self.subscription_covering else None,
         }

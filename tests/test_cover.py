@@ -1,0 +1,528 @@
+"""Subscription covering (ISSUE 18): match the covering set, expand at
+fan-out.
+
+Covering must be INVISIBLE except for speed. The proof obligations:
+
+- `covers_pair` (the pure-python covering oracle) against BRUTE-FORCE
+  topic enumeration through HostTrie — trailing-'#', '+'-vs-literal per
+  level, '$'-prefix exclusion, self-cover;
+- vectorized `detect_covers` against exhaustive `covers_pair` pairwise
+  sweeps over mixed populations;
+- per-filter order keys reproduce both backends' emission order;
+- engine A/B twins (covering on vs off) bit-identical on delivery
+  counts AND per-session delivery order across clean / shared-group /
+  '$'-topic / dirty-overlay / churn traffic and all backend pairings
+  (shapes-shapes, trie-off vs shapes-root-on, trie-trie), plus the
+  2/4/8-shard mesh;
+- the append path: a covered new subscription lands in the expansion
+  CSR (no rebuild) and the match cache drops cached topics against the
+  EXPANDED set — insert and delete;
+- knob resolution (broker.subscription_covering beats
+  EMQX_TPU_COVERING beats default-on) and the stats/ledger surfaces
+  (cover_csr HBM category);
+- the shared workload generator actually produces the cover ratio it
+  promises (tools/workloads.py) and the legacy population stays
+  cover-free.
+"""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker import device_engine as DE
+from emqx_tpu.broker.message import make
+from emqx_tpu.broker.node import Node
+from emqx_tpu.ops import cover as C
+from emqx_tpu.ops.intern import PAD, InternTable
+from emqx_tpu.ops.trie import HostTrie
+
+
+class Sink:
+    def __init__(self):
+        self.got = []
+
+    def deliver(self, topic_filter, msg):
+        self.got.append((topic_filter, msg.topic, bytes(msg.payload)))
+        return True
+
+
+def mkmsg(topic, payload=b"x"):
+    return make("pub", 0, topic, payload)
+
+
+def _encode(intern, filters):
+    L = max(len(f.split("/")) for f in filters)
+    rows = np.zeros((len(filters), L), np.int32)
+    lens = np.zeros(len(filters), np.int64)
+    for i, f in enumerate(filters):
+        ids = intern.encode_filter(f.split("/"))
+        rows[i, :len(ids)] = ids
+        lens[i] = len(ids)
+    dollar = np.fromiter((f.startswith("$") for f in filters), bool,
+                         len(filters))
+    return rows, lens, dollar
+
+
+# the covering edge cases named by the issue, all in one population:
+# trailing '#' over exact/'+'/deeper-'#', '+' vs literal per level,
+# root-'$' exclusion, '#' root, identical-shape distinct filters
+EDGE_FILTERS = [
+    "#", "a/#", "a/b", "a/+", "+/b", "a/b/#", "a/b/c", "a/+/c",
+    "+/+", "a/+/+", "+/b/c", "s/#", "s/+/t", "s/u/t", "s/u/v",
+    "$SYS/#", "$SYS/x", "$SYS/+", "b/#", "b/+/#",
+]
+
+
+def _enum_topics(words, depth):
+    """Every topic over `words` up to `depth` levels."""
+    out = [[w] for w in words]
+    frontier = [[w] for w in words]
+    for _ in range(depth - 1):
+        frontier = [t + [w] for t in frontier for w in words]
+        out.extend(frontier)
+    return out
+
+
+class TestCoversPairOracle:
+    def test_against_topic_enumeration(self):
+        """A covers B == topics(B) subset-of topics(A), brute-forced
+        through HostTrie over an alphabet that exercises '$' roots."""
+        intern = InternTable()
+        # every literal appearing in EDGE_FILTERS, so no filter's
+        # enumerated topic set is vacuously empty
+        alphabet = ["a", "b", "c", "s", "t", "u", "v", "x", "$SYS"]
+        topics = _enum_topics(alphabet, 4)
+        enc = {}
+        for i, f in enumerate(EDGE_FILTERS):
+            t = HostTrie()
+            t.insert(intern.encode_filter(f.split("/")), i)
+            enc[f] = t
+
+        def topic_set(f):
+            t = enc[f]
+            out = set()
+            for tw in topics:
+                ids = [intern.lookup(w) for w in tw]
+                if t.match(ids, is_dollar=tw[0].startswith("$")):
+                    out.add(tuple(tw))
+            return out
+
+        tsets = {f: topic_set(f) for f in EDGE_FILTERS}
+        for fa in EDGE_FILTERS:
+            wa = intern.encode_filter(fa.split("/"))
+            for fb in EDGE_FILTERS:
+                wb = intern.encode_filter(fb.split("/"))
+                got = C.covers_pair(wa, wb,
+                                    b_dollar=fb.startswith("$"))
+                want = tsets[fb] <= tsets[fa]
+                assert got == want, (fa, fb, got, want)
+
+    def test_pointwise_cases(self):
+        it = InternTable()
+
+        def cp(a, b):
+            return C.covers_pair(it.encode_filter(a.split("/")),
+                                 it.encode_filter(b.split("/")),
+                                 b_dollar=b.startswith("$"))
+
+        assert cp("a/#", "a/b") and cp("a/#", "a/+") and cp("a/#", "a")
+        assert cp("a/#", "a/b/#") and cp("#", "a/b/c")
+        assert not cp("a/b/#", "a/#")        # deeper '#' covers less
+        assert cp("a/+", "a/b") and not cp("a/b", "a/+")
+        assert not cp("a/+", "a/b/c")        # '+' is exactly one level
+        assert not cp("a/+", "a/#")          # '#' matches deeper
+        assert not cp("#", "$SYS/x") and not cp("+/#", "$SYS/x")
+        assert cp("$SYS/#", "$SYS/x")        # '$' literal root is fine
+        assert cp("a/b", "a/b")              # self-cover: caller excludes
+
+
+class TestDetection:
+    def test_matches_exhaustive_pairwise(self):
+        from tools.workloads import cover_heavy_filters
+        intern = InternTable()
+        filters = sorted(set(EDGE_FILTERS
+                             + cover_heavy_filters(120, cover_ratio=0.5)))
+        rows, lens, dollar = _encode(intern, filters)
+        covers, inc = C.detect_covers(rows, lens, dollar)
+        assert not inc.any()
+        n = len(filters)
+        for b in range(n):
+            wb = [int(x) for x in rows[b, :lens[b]]]
+            want = {a for a in range(n) if a != b and C.covers_pair(
+                [int(x) for x in rows[a, :lens[a]]], wb,
+                b_dollar=bool(dollar[b]))}
+            assert set(int(x) for x in covers[b]) == want, filters[b]
+
+    def test_assign_owners_roots_and_budget(self):
+        intern = InternTable()
+        filters = ["a/#", "a/1", "a/2", "a/3", "b/c"]
+        rows, lens, dollar = _encode(intern, filters)
+        covers, inc = C.detect_covers(rows, lens, dollar)
+        owner = C.assign_owners(covers, inc)
+        assert owner[0] == -1 and owner[4] == -1       # roots
+        assert list(owner[1:4]) == [0, 0, 0]
+        # budget: each cover owns at most own_budget covered filters
+        owner2 = C.assign_owners(covers, inc, own_budget=2)
+        assert (owner2[1:4] == 0).sum() == 2
+        assert (owner2 == -1).sum() == 3               # overflow -> root
+
+    def test_order_keys_reproduce_trie_emission(self):
+        import jax.numpy as jnp
+        from emqx_tpu.ops.match import match_batch
+        from emqx_tpu.ops.trie import build_tables
+        intern = InternTable()
+        filters = EDGE_FILTERS
+        rows, lens, dollar = _encode(intern, filters)
+        keys = C.trie_order_keys(rows, lens)
+        tt = build_tables(rows, lens, node_capacity=256,
+                          slot_capacity=1024)
+        for topic in ("a/b", "a/b/c", "s/u/t", "s/u/v", "$SYS/x", "b"):
+            tw = topic.split("/")
+            ids = np.full((1, rows.shape[1]), PAD, np.int32)
+            ids[0, :len(tw)] = [intern.lookup(w) for w in tw]
+            mr = match_batch(tt, jnp.asarray(ids),
+                             jnp.asarray([len(tw)], np.int32),
+                             jnp.asarray([topic.startswith("$")]))
+            row = [int(x) for x in np.asarray(mr.matches)[0]
+                   if int(x) >= 0]
+            assert row == sorted(row, key=lambda f: keys[f]), topic
+            # keys are UNIQUE within one topic's match set — ties can
+            # never co-occur, which is what makes the expansion sort
+            # backend-independent
+            assert len({int(keys[f]) for f in row}) == len(row)
+
+
+# ---------------- engine A/B twins ----------------
+
+POPULATIONS = {
+    # both twins on the shapes backend (few shapes)
+    "shapes": ["s/#", "s/+/t", "s/u/t", "s/u/v", "s/a/t",
+               "q/1", "q/2", "w/+", "w/x"],
+    # off twin trie (diverse shapes force past shape_cap via deep '+'
+    # spread), on twin shapes-over-roots — the mixed-backend pairing
+    "mixed": (["top/#"]
+              + [f"top/{'+/' * (i % 4)}x{i}" for i in range(12)]
+              + [f"d{i}/{'+/' * (i % 5)}m{i}/t{i}" for i in range(12)]
+              + ["top/a/b", "top/+/c"]),
+}
+
+
+def _mk_twin_nodes(filters, conf=None):
+    """(covering-on, covering-off) nodes with one sink+sid per filter."""
+    nodes = []
+    for covering in (True, False):
+        cfg = {"broker": dict(conf or {},
+                              subscription_covering=covering)}
+        node = Node(cfg)
+        sinks, sids = {}, {}
+        for i, f in enumerate(filters):
+            s = Sink()
+            sid = node.broker.register(s, f"c{i}")
+            node.broker.subscribe(sid, f, {"qos": 0})
+            sinks[f], sids[f] = s, sid
+        nodes.append((node, sinks, sids))
+    return nodes
+
+
+def _route_and_compare(on, off, topics, payload=b"x"):
+    (n1, s1, _), (n2, s2, _) = on, off
+    c1 = n1.device_engine.route_batch([mkmsg(t, payload)
+                                       for t in topics])
+    c2 = n2.device_engine.route_batch([mkmsg(t, payload)
+                                       for t in topics])
+    assert c1 is not None and c2 is not None
+    assert c1 == c2, (c1, c2)
+    # per-session delivery ORDER, not just counts
+    for f in s1:
+        assert s1[f].got == s2[f].got, f
+    return c1
+
+
+TRAFFIC = ["s/u/t", "s/u/v", "s/q", "s/a/t", "q/1", "w/x", "nomatch/z",
+           "top/a/b", "top/zz", "top/x1", "d3/m3/t3", "$SYS/x"]
+
+
+class TestEngineTwins:
+    @pytest.mark.parametrize("pop", sorted(POPULATIONS))
+    def test_clean_dirty_churn_twins(self, pop):
+        filters = POPULATIONS[pop]
+        on, off = _mk_twin_nodes(filters)
+        # clean snapshot, repeated (cache-hit rounds included)
+        for rnd in range(3):
+            _route_and_compare(on, off, TRAFFIC, b"r%d" % rnd)
+        if pop == "mixed":
+            st = on[0].device_engine.stats()
+            assert st["cover"] and st["cover"]["covered"] > 0
+        # dirty overlay: post-snapshot subscriptions — for the shapes
+        # population "s/u/new" is covered by the built "s/#" (append
+        # path on the on-twin); for mixed there is no covering root, so
+        # it rides the overlay on both; "fresh/+" is uncovered always
+        for node, sinks, _sids in (on, off):
+            s = Sink()
+            sid = node.broker.register(s, "dirty")
+            node.broker.subscribe(sid, "s/u/new", {"qos": 0})
+            node.broker.subscribe(sid, "fresh/+", {"qos": 0})
+            sinks["s/u/new"] = sinks["fresh/+"] = s
+        _route_and_compare(on, off, TRAFFIC + ["s/u/new", "fresh/go"],
+                           b"dirty")
+        # churn: unsubscribe a BUILT literal filter (covered on the
+        # on-twin — its tombstone must drop it from the expanded rows)
+        victim = [f for f in filters if "+" not in f and "#" not in f][0]
+        for node, _sinks, sids in (on, off):
+            node.broker.unsubscribe(sids[victim], victim)
+        _route_and_compare(on, off, TRAFFIC, b"churn")
+
+    def test_trie_both_twins(self):
+        """shape_cap=0 forces BOTH twins onto the trie backend."""
+        filters = POPULATIONS["shapes"]
+        on, off = _mk_twin_nodes(filters)
+        for node, _sinks, _sids in (on, off):
+            node.device_engine.shape_cap = 0
+        for rnd in range(2):
+            _route_and_compare(on, off, TRAFFIC, b"t%d" % rnd)
+        assert on[0].device_engine.stats()["backend"] == "trie"
+        assert off[0].device_engine.stats()["backend"] == "trie"
+        assert on[0].device_engine.stats()["cover"]["covered"] > 0
+
+    def test_shared_groups_post_expansion(self):
+        """Shared-sub picks resolve on EXPANDED rows: a group on a
+        covered filter must rotate identically across the twins."""
+        filters = ["g/#", "g/+/t", "g/a/t"]
+        on, off = _mk_twin_nodes(filters)
+        for node, sinks, _sids in (on, off):
+            a, bb = Sink(), Sink()
+            node.broker.subscribe(node.broker.register(a, "m1"),
+                                  "$share/grp/g/+/t")
+            node.broker.subscribe(node.broker.register(bb, "m2"),
+                                  "$share/grp/g/+/t")
+            sinks["m1"], sinks["m2"] = a, bb
+        for rnd in range(3):
+            _route_and_compare(
+                on, off, ["g/a/t", "g/b/t", "g/c", "g/a/t"],
+                b"s%d" % rnd)
+
+    def test_unsubscribe_covered_filter(self):
+        """Deleting a covered filter must stop its deliveries on both
+        twins identically (tombstone against the expanded set)."""
+        filters = ["s/#", "s/+/t", "s/u/t"]
+        on, off = _mk_twin_nodes(filters)
+        _route_and_compare(on, off, ["s/u/t"])
+        for node, _sinks, sids in (on, off):
+            node.broker.unsubscribe(sids["s/+/t"], "s/+/t")
+        _route_and_compare(on, off, ["s/u/t", "s/x/t"])
+
+
+# ---------------- append path & cache invalidation ----------------
+
+class TestAppendAndCache:
+    def _node(self, **conf):
+        node = Node({"broker": dict(conf, subscription_covering=True)})
+        return node
+
+    def test_covered_new_sub_is_csr_append_not_rebuild(self):
+        node = self._node()
+        s = Sink()
+        sid = node.broker.register(s, "base")
+        for f in ("s/#", "s/+/t", "other/x"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        eng = node.device_engine
+        assert eng.route_batch([mkmsg("s/q")]) == [1]
+        # new covered sub -> append, no overlay row, no rebuild
+        s2 = Sink()
+        node.broker.subscribe(node.broker.register(s2, "new"), "s/b")
+        assert node.metrics.val("routing.cover.appends") == 1
+        st = eng.stats()
+        assert st["delta_filters"] == 0
+        assert st["cover"]["appends"] == 1
+        # s/b now matches s/# (base) and the appended s/b (new)
+        assert eng.route_batch([mkmsg("s/b")]) == [2]
+        assert [g[1] for g in s2.got] == ["s/b"]
+
+    def test_cache_invalidation_walks_expanded_set(self):
+        """The cached-topic drop must test the EXPANDED set: a cached
+        topic whose row came from a covering root must be dropped when
+        an appended filter matches it."""
+        node = self._node()
+        s = Sink()
+        sid = node.broker.register(s, "base")
+        for f in ("s/#", "s/+/t"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        eng = node.device_engine
+        # seed the match cache for the topic the append will match
+        # (batches must exceed the smallest class so analysis runs)
+        assert eng.route_batch([mkmsg("s/b")] * 70
+                               + [mkmsg("s/c")] * 70) == [1] * 140
+        assert eng.route_batch([mkmsg("s/b")] * 70) == [1] * 70
+        hits0 = eng.stats()["match_cache"]["hits"]
+        assert hits0 >= 1
+        s2 = Sink()
+        node.broker.subscribe(node.broker.register(s2, "new"), "s/b")
+        assert node.metrics.val("routing.cover.appends") == 1
+        # cached row for s/b was dropped: the new subscriber delivers
+        assert eng.route_batch([mkmsg("s/b")] * 70) == [2] * 70
+        assert s2.got and all(g[1] == "s/b" for g in s2.got)
+        # unrelated cached topics survive (drop is per expanded match,
+        # not a flush)
+        assert eng.route_batch([mkmsg("s/c")] * 70) == [1] * 70
+
+    def test_overlay_delete_drops_cached_expanded_topic(self):
+        node = self._node()
+        s, s2 = Sink(), Sink()
+        sid = node.broker.register(s, "base")
+        for f in ("s/#", "s/+/t"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        sid2 = node.broker.register(s2, "victim")
+        node.broker.subscribe(sid2, "s/u/t", {"qos": 0})
+        eng = node.device_engine
+        assert eng.route_batch([mkmsg("s/u/t")] * 70) == [3] * 70
+        assert eng.route_batch([mkmsg("s/u/t")] * 70) == [3] * 70
+        node.broker.unsubscribe(sid2, "s/u/t")
+        # the drop walked the expanded set: covered filter's topic
+        # re-resolves without the removed subscriber
+        assert eng.route_batch([mkmsg("s/u/t")] * 70) == [2] * 70
+
+    def test_new_covering_filter_counts_toward_compaction(self):
+        node = self._node()
+        s = Sink()
+        sid = node.broker.register(s, "base")
+        for f in ("s/#", "s/+/t", "q/x"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        eng = node.device_engine
+        eng.rebuild()
+        churn0 = eng._cover_churn
+        # a new COVERING filter cannot append (it must own a segment):
+        # it rides the overlay and marks cover churn for compaction
+        node.broker.subscribe(sid, "q/#", {"qos": 0})
+        assert eng._cover_churn > churn0
+        assert node.metrics.val("routing.cover.append_rejects") >= 1
+        assert eng._compaction_reason() in (None, "covering",
+                                            "overflow", "churn",
+                                            "tombstones")
+
+
+# ---------------- knob & surfaces ----------------
+
+class TestKnobAndSurfaces:
+    def test_config_beats_env_beats_default(self, monkeypatch):
+        assert DE.resolve_subscription_covering() is True
+        monkeypatch.setenv("EMQX_TPU_COVERING", "0")
+        assert DE.resolve_subscription_covering() is False
+        assert DE.resolve_subscription_covering(True) is True
+        monkeypatch.setenv("EMQX_TPU_COVERING", "off")
+        assert DE.resolve_subscription_covering() is False
+        monkeypatch.delenv("EMQX_TPU_COVERING")
+        assert DE.resolve_subscription_covering(False) is False
+
+    def test_env_routes_engine_and_mesh(self, monkeypatch):
+        monkeypatch.setattr(DE, "_ENV_COVERING", False)
+        node = Node({})
+        assert node.device_engine.subscription_covering is False
+        node2 = Node({"broker": {"subscription_covering": True}})
+        assert node2.device_engine.subscription_covering is True
+
+    def test_stats_and_ledger_category(self):
+        node = Node({"broker": {"subscription_covering": True}})
+        s = Sink()
+        sid = node.broker.register(s, "c")
+        for f in ("s/#", "s/+/t", "s/u/t"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        eng = node.device_engine
+        eng.rebuild()
+        st = eng.stats()
+        assert st["subscription_covering"] is True
+        cov = st["cover"]
+        assert cov["roots"] >= 1 and cov["covered"] == 2
+        assert cov["reduction"] == pytest.approx(3.0)
+        # expansion-CSR buffers ride their own HBM category
+        led = node.hbm_ledger
+        assert led is not None
+        cats = led.section()["categories"]
+        assert "cover_csr" in cats
+        assert cats["cover_csr"]["live_bytes"] > 0
+
+    def test_off_twin_has_no_cover_state(self):
+        node = Node({"broker": {"subscription_covering": False}})
+        s = Sink()
+        sid = node.broker.register(s, "c")
+        for f in ("s/#", "s/+/t"):
+            node.broker.subscribe(sid, f, {"qos": 0})
+        node.device_engine.rebuild()
+        st = node.device_engine.stats()
+        assert st["subscription_covering"] is False
+        assert st["cover"] is None
+
+
+# ---------------- workloads generator ----------------
+
+class TestWorkloads:
+    def test_cover_ratio_is_detected(self):
+        from tools.workloads import cover_heavy_filters
+        filters = sorted(set(cover_heavy_filters(400, cover_ratio=0.5)))
+        intern = InternTable()
+        rows, lens, dollar = _encode(intern, filters)
+        covers, inc = C.detect_covers(rows, lens, dollar)
+        owner = C.assign_owners(covers, inc)
+        frac = (owner >= 0).sum() / len(filters)
+        assert frac >= 0.4, frac
+
+    def test_legacy_population_is_cover_free(self):
+        from tools.workloads import shape_spread_filters
+        filters = shape_spread_filters(300, tail_hash=True)
+        intern = InternTable()
+        rows, lens, dollar = _encode(intern, filters)
+        covers, _inc = C.detect_covers(rows, lens, dollar)
+        assert all(len(c) == 0 for c in covers)
+
+    def test_concretize_matches_its_filter(self):
+        from tools.workloads import (concretize, cover_heavy_filters,
+                                     shape_spread_filters)
+        intern = InternTable()
+        for f in (cover_heavy_filters(60, cover_ratio=0.5)
+                  + shape_spread_filters(20)):
+            t = concretize(f)
+            trie = HostTrie()
+            trie.insert(intern.encode_filter(f.split("/")), 0)
+            ids = [intern.lookup(w) for w in t.split("/")]
+            assert trie.match(ids, is_dollar=t.startswith("$")) == [0], \
+                (f, t)
+
+
+# ---------------- mesh twins ----------------
+
+@pytest.mark.parametrize("route", [2, 4, 8])
+def test_mesh_twin_bit_identical(route):
+    filters = (["m/#", "m/+/t"] + [f"m/{i}/t" for i in range(6)]
+               + [f"n{i}/+/w" for i in range(4)] + ["$SYS/#", "deep/#"])
+    topics = ([f"m/{i}/t" for i in range(6)]
+              + ["m/zz/t", "m/q", "n1/a/w", "$SYS/x", "none/x"])
+    results = []
+    for covering in (True, False):
+        node = Node({"broker": {
+            "multichip": {"enable": True, "devices": route, "dp": 1,
+                          "max_batch": 32},
+            "device_min_batch": 1,
+            "subscription_covering": covering}})
+        sinks = {}
+        for i, f in enumerate(filters):
+            s = Sink()
+            node.broker.subscribe(node.broker.register(s, f"c{i}"), f)
+            sinks[f] = s
+        eng = node.device_engine
+        eng.rebuild()
+        counts = []
+        for rnd in range(2):
+            counts.append(eng.route_batch(
+                [mkmsg(t, b"r%d" % rnd) for t in topics], wait=True))
+        # churn: covered new sub + removal, served via per-shard rebuild
+        s = Sink()
+        node.broker.subscribe(node.broker.register(s, "late"), "m/late/t")
+        sinks["m/late/t"] = s
+        counts.append(eng.route_batch(
+            [mkmsg(t, b"c") for t in topics + ["m/late/t"]], wait=True))
+        st = eng.stats()
+        assert st["subscription_covering"] is covering
+        if covering:
+            assert st["cover"]["covered"] > 0
+        results.append((counts, {f: sinks[f].got for f in sinks}))
+    (c_on, got_on), (c_off, got_off) = results
+    assert c_on == c_off
+    assert got_on == got_off

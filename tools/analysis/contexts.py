@@ -273,6 +273,12 @@ class ContextGraph:
                 if src is not None:
                     tgt = self._mod_funcs.get(src, {}).get(m)
                     return [tgt] if tgt is not None else []
+                if recv.id in self._imports.get(fi.mod.path, {}):
+                    # `import x as y; y.m(...)` where x is NOT a repo
+                    # module: the receiver is an external module, so
+                    # duck-matching repo methods named m (jnp.all ->
+                    # Banned.all) would fabricate edges
+                    return []
             cands = self._methods_by_name.get(m, [])
             if cands and len(cands) <= DUCK_MAX and m not in DUCK_STOP:
                 return list(cands)

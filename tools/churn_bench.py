@@ -25,7 +25,9 @@ with the baseline's non-zero count measuring the hole being closed).
 
 Env knobs: CHURN_FILTERS (5000), CHURN_BATCH (512), CHURN_BATCHES (48),
 CHURN_RATE (4 subscribes/batch), CHURN_LIVE (64 rolling live churn
-subscriptions), CHURN_THRESHOLD (32), CHURN_WARM_PASSES (2).
+subscriptions), CHURN_THRESHOLD (32), CHURN_WARM_PASSES (2),
+CHURN_COVER_RATIO (0 — >0 swaps in the cover-heavy population from
+tools/workloads.py).
 
 Run directly or as `python bench.py --churn`.
 """
@@ -59,18 +61,19 @@ def _mk_node(overlay: bool, threshold: int):
 
 
 def _subscribe_base(node, n_filters: int) -> list:
-    """Built-snapshot filters spread over several shapes (same generator
-    family as tools/skew_bench.py so rates are comparable)."""
+    """Built-snapshot filters from the shared generator
+    (tools/workloads.py, ISSUE 18 satellite): CHURN_COVER_RATIO=0 keeps
+    the legacy zero-cover shape-spread population byte-identical (rates
+    comparable with history AND with tools/skew_bench.py); >0 switches
+    to the cover-heavy population so churn cost can be measured where
+    covering actually bites."""
+    from tools.workloads import cover_heavy_filters, shape_spread_filters
+    ratio = float(os.environ.get("CHURN_COVER_RATIO", 0))
+    filters = cover_heavy_filters(n_filters, cover_ratio=ratio) if ratio \
+        else shape_spread_filters(n_filters)
     b = node.broker
     sid = b.register(_Sink(), "churn-base")
-    filters = []
-    for i in range(n_filters):
-        depth = 3 + (i % 8)
-        mid = i % depth
-        levels = [f"s{i}" if li != mid else "+" for li in range(depth)]
-        levels[0] = f"d{i % 97}"
-        f = "/".join(levels) + f"/t{i}"
-        filters.append(f)
+    for f in filters:
         b.subscribe(sid, f, {"qos": 0})
     return filters
 
@@ -80,9 +83,7 @@ def _topics_for(filters, rng, batch: int, n_batches: int,
     """Per-batch topic lists: mostly built-filter traffic, with a slice
     reserved for churn topics (filled in per round — the messages the
     rolling fresh subscriptions must catch)."""
-    def concretize(f):
-        return "/".join(p if p not in ("+", "#") else f"x{i}"
-                        for i, p in enumerate(f.split("/")))
+    from tools.workloads import concretize
 
     pool = [concretize(f) for f in filters[:4096]]
     out = []

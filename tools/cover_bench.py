@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Subscription-covering microbenchmark (ISSUE 18 acceptance).
+
+Measures topic-matches/sec through the REAL DeviceRouteEngine match
+stages (prepare → dispatch → materialize) with subscription covering ON
+vs OFF, on two populations from tools/workloads.py:
+
+  cover-heavy   cover_heavy_filters(ratio COVER_RATIO >= 0.5): umbrella
+                filters cover most of the population, so the covering
+                set the device actually matches is a fraction of the
+                subscription count — the arXiv:1811.07088 shape of real
+                broker populations. Acceptance: covering ON >= 2x OFF,
+                reported next to the covering-set reduction factor so
+                the speedup is attributable.
+  uniform       shape_spread_filters: ZERO cover relations by
+                construction. Covering ON must not regress (>= 0.95x)
+                — detection finds nothing and the engine skips the
+                expansion stage entirely.
+
+Both engines run with dedup + match cache OFF: the cache would serve
+repeated pool topics host-side and hide the match-stage difference
+under test (the cache's own win is tools/skew_bench.py's number).
+Consume is excluded for the same reason as the skew bench — identical
+on both paths. A final full route_batch per engine pair cross-checks
+delivery counts, so the measured twin is also a correct twin.
+
+Env knobs: COVER_FILTERS (10000), COVER_BATCH (1024), COVER_BATCHES
+(32), COVER_RATIO (0.6).
+
+Run directly or as `python bench.py --cover`.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class _Sink:
+    def deliver(self, topic_filter, msg):
+        return True
+
+
+def _mk_node(covering: bool):
+    from emqx_tpu.broker.node import Node
+
+    # dedup/cache off (see module docstring); tight fan-out/slot caps —
+    # one subscriber per filter, same trim as skew_bench/bench.py
+    return Node({"broker": {"subscription_covering": covering,
+                            "topic_dedup": False,
+                            "device_fanout_cap": 4,
+                            "device_slot_cap": 2}})
+
+
+def _subscribe(node, filters: list, tag: str) -> None:
+    b = node.broker
+    sid = b.register(_Sink(), f"cover-{tag}")
+    for f in filters:
+        b.subscribe(sid, f, {"qos": 0})
+
+
+def _topics_for(filters: list, rng, batch: int, n_batches: int):
+    """Concrete topics drawn uniformly over the WHOLE population (roots
+    and covered filters both get traffic — expansion correctness and
+    cost are part of the measured path)."""
+    from tools.workloads import concretize
+
+    pool = [concretize(f) for f in filters]
+    return [[pool[i] for i in rng.randint(0, len(pool), batch)]
+            for _ in range(n_batches)]
+
+
+def _run_engine(node, batches, label: str) -> float:
+    """Route every batch through prepare/dispatch/materialize; best of
+    two timed passes after a warm pass (same discipline as skew_bench —
+    symmetric for both engines)."""
+    from emqx_tpu.broker.message import make
+
+    eng = node.device_engine
+    msg_batches = [[make("p", 0, t, b"x") for t in topics]
+                   for topics in batches]
+    eng.rebuild()
+
+    def one(msgs):
+        h = eng.prepare(msgs, gate_cold=False)
+        assert h is not None
+        eng.dispatch(h)
+        eng.materialize(h)
+        eng.abandon(h)      # consume excluded: identical on both paths
+
+    for msgs in msg_batches:            # warm pass: XLA compiles
+        one(msgs)
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for msgs in msg_batches:
+            one(msgs)
+        dt = min(dt, time.perf_counter() - t0)
+    total = sum(len(m) for m in msg_batches)
+    log(f"{label}: {total} topics in {dt:.3f}s "
+        f"({total / dt / 1e3:.1f}k matches/s)")
+    return total / dt
+
+
+def _counts_equal(node_on, node_off, topics: list) -> bool:
+    """Delivery-count cross-check on a fresh batch (full route_batch,
+    consume included): the measured twin must also be a correct twin."""
+    from emqx_tpu.broker.message import make
+    on = node_on.device_engine.route_batch(
+        [make("p", 0, t, b"v") for t in topics])
+    off = node_off.device_engine.route_batch(
+        [make("p", 0, t, b"v") for t in topics])
+    return on is not None and on == off
+
+
+def _pair(filters, batches, check_topics, tag: str):
+    on, off = _mk_node(True), _mk_node(False)
+    _subscribe(on, filters, tag)
+    _subscribe(off, filters, tag)
+    off_ps = _run_engine(off, batches, f"{tag}:covering-off")
+    on_ps = _run_engine(on, batches, f"{tag}:covering-on")
+    st = on.device_engine.stats()
+    assert st["subscription_covering"] and not \
+        off.device_engine.stats()["subscription_covering"]
+    return {
+        "on_per_s": round(on_ps),
+        "off_per_s": round(off_ps),
+        "speedup": round(on_ps / off_ps, 2),
+        "cover": st["cover"],
+        "backend": st["backend"],
+        "counts_equal": _counts_equal(on, off, check_topics),
+    }
+
+
+def run_cover() -> dict:
+    from tools.workloads import (concretize, cover_heavy_filters,
+                                 shape_spread_filters)
+
+    n_filters = int(os.environ.get("COVER_FILTERS", 10_000))
+    batch = int(os.environ.get("COVER_BATCH", 1024))
+    n_batches = int(os.environ.get("COVER_BATCHES", 32))
+    ratio = float(os.environ.get("COVER_RATIO", 0.6))
+
+    rng = np.random.RandomState(17)
+    heavy = cover_heavy_filters(n_filters, cover_ratio=ratio)
+    uniform = shape_spread_filters(n_filters)
+    log(f"cover bench: {n_filters} filters, ratio {ratio}, "
+        f"{n_batches} batches of {batch}")
+    heavy_batches = _topics_for(heavy, rng, batch, n_batches)
+    uni_batches = _topics_for(uniform, rng, batch, n_batches)
+    check = [concretize(f) for f in heavy[:: max(1, n_filters // 64)]]
+
+    heavy_row = _pair(heavy, heavy_batches, check, "cover-heavy")
+    uni_row = _pair(uniform, uni_batches,
+                    [concretize(f) for f in uniform[:64]], "uniform")
+    return {
+        "metric": "cover_topic_matches_per_sec",
+        "unit": "topic-matches/s",
+        # acceptance: >= 2.0 at ratio >= 0.5, next to the reduction
+        # factor that explains it
+        "cover_heavy": heavy_row,
+        # acceptance: >= 0.95 (covering free when nothing covers)
+        "uniform": uni_row,
+        "workload": {"filters": n_filters, "batch": batch,
+                     "batches": n_batches, "cover_ratio": ratio},
+    }
+
+
+def main():
+    print(json.dumps(run_cover()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

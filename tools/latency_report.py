@@ -23,7 +23,10 @@ artifact from {phase0, latency0, e2e_host, e2e_device} — a row that
 ran but lost its latency section fails; a phase that never ran (e.g.
 BENCH_E2E=0) is not invented. ``--require a,b`` pins an explicit list
 instead (a named row that is absent then also fails: the gate is "this
-round MUST carry these measured tails").
+round MUST carry these measured tails"). The microbench rows
+``sharded`` and ``cover`` are also requirable: they carry matches/s +
+speedup headlines instead of a latency section, so for them the gate
+is row presence and the report prints their scalar summary.
 """
 
 from __future__ import annotations
@@ -33,6 +36,10 @@ import sys
 
 # the phase rows that must carry a latency section when present
 DEFAULT_ROWS = ("phase0", "latency0", "e2e_host", "e2e_device")
+# microbench phase rows --require can pin: they carry their own metric
+# (matches/s, speedup, reduction) instead of a latency section, so the
+# gate checks PRESENCE and renders the headline numbers
+MICRO_ROWS = ("sharded", "cover")
 
 
 def _rows_of(doc: dict) -> dict:
@@ -48,7 +55,29 @@ def _rows_of(doc: dict) -> dict:
         return {"row": doc}
     # merged bench JSON: phase rows are top-level keys
     return {k: v for k, v in doc.items()
-            if k in DEFAULT_ROWS and isinstance(v, dict)}
+            if k in DEFAULT_ROWS + MICRO_ROWS and isinstance(v, dict)}
+
+
+def _render_micro(name: str, row: dict) -> str:
+    """Headline numbers of a latency-less microbench row (one line per
+    nesting level — enough for the round log, not a full report)."""
+    def scalars(d):
+        return {k: v for k, v in d.items()
+                if isinstance(v, (int, float, str, bool))}
+
+    out = [f"== {name} =="]
+    top = scalars(row)
+    if top:
+        out.append("  " + " ".join(f"{k}={v}"
+                                   for k, v in sorted(top.items())))
+    for k in sorted(row):
+        v = row[k]
+        if isinstance(v, dict):
+            s = scalars(v)
+            if s:
+                out.append(f"  {k}: " + " ".join(
+                    f"{kk}={vv}" for kk, vv in sorted(s.items())))
+    return "\n".join(out)
 
 
 def _latency_of(row: dict):
@@ -148,6 +177,15 @@ def main(argv=None) -> int:
     printed = 0
     for name in wanted:
         row = rows.get(name)
+        if name in MICRO_ROWS:
+            # microbench rows (sharded/cover) carry their own metric,
+            # not a latency section: the gate is row PRESENCE
+            if row is None:
+                missing.append(name)
+                continue
+            print(_render_micro(name, row))
+            printed += 1
+            continue
         lat = _latency_of(row) if row else None
         if lat is None:
             missing.append(name)
@@ -155,10 +193,11 @@ def main(argv=None) -> int:
         print(render(name, lat, overload=_overload_of(row)))
         printed += 1
     if missing:
-        print(f"latency_report: bench rows carry NO latency section: "
-              f"{missing} — this round would commit a p99-less "
-              f"headline (run with EMQX_TPU_LATENCY=1 / "
-              f"BENCH_LATENCY0=1)", file=sys.stderr)
+        print(f"latency_report: required bench rows missing or carry "
+              f"NO latency section: {missing} — this round would "
+              f"commit a p99-less headline (run with "
+              f"EMQX_TPU_LATENCY=1 / BENCH_LATENCY0=1)",
+              file=sys.stderr)
         return 2
     if not printed:
         print("latency_report: artifact contains no latency-bearing "

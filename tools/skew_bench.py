@@ -24,7 +24,8 @@ reduction (compact vs dense, >= 4x at this workload's fan-out of 1)
 with no matches/s regression (`compact_vs_dense`).
 
 Env knobs: SKEW_FILTERS (10000), SKEW_BATCH (1024), SKEW_BATCHES (48),
-SKEW_HOT (16), SKEW_HOT_PCT (90), SKEW_ZIPF (0).
+SKEW_HOT (16), SKEW_HOT_PCT (90), SKEW_ZIPF (0), SKEW_COVER_RATIO (0 —
+>0 swaps in the cover-heavy population from tools/workloads.py).
 
 Run directly or as `python bench.py --skew`.
 """
@@ -62,20 +63,18 @@ def _mk_node(dedup: bool, compact: bool = True):
 
 
 def _subscribe_all(node, n_filters: int) -> list:
-    """`n_filters` wildcard filters spread over many SHAPES (depth and
-    '+' position vary), so the match stage carries real per-shape work —
-    the component the reuse layers remove."""
+    """`n_filters` wildcard filters from the shared generator
+    (tools/workloads.py, ISSUE 18 satellite). SKEW_COVER_RATIO=0 keeps
+    the legacy zero-cover shape-spread population byte-identical (many
+    shapes, real per-shape match work — the component the reuse layers
+    remove); >0 switches to the cover-heavy population."""
+    from tools.workloads import cover_heavy_filters, shape_spread_filters
+    ratio = float(os.environ.get("SKEW_COVER_RATIO", 0))
+    filters = cover_heavy_filters(n_filters, cover_ratio=ratio) if ratio \
+        else shape_spread_filters(n_filters, tail_hash=True)
     b = node.broker
     sid = b.register(_Sink(), "skew-sink")
-    filters = []
-    for i in range(n_filters):
-        depth = 3 + (i % 8)            # 8 depths x 2 tails = 16 shapes
-        mid = i % depth
-        levels = [f"s{i}" if li != mid else "+" for li in range(depth)]
-        levels[0] = f"d{i % 97}"       # shared vocabulary up front
-        tail = "#" if i % 2 else f"t{i}"
-        f = "/".join(levels) + "/" + tail
-        filters.append(f)
+    for f in filters:
         b.subscribe(sid, f, {"qos": 0})
     return filters
 
@@ -84,11 +83,7 @@ def _topics_for(filters: list, rng, n_hot: int, hot_pct: int,
                 zipf: bool, batch: int, n_batches: int):
     """Pre-built per-batch topic lists: hot-set (or Zipf) skewed over
     concrete topics that each match one filter."""
-    def concretize(f: str) -> str:
-        parts = f.split("/")
-        out = [p if p not in ("+", "#") else f"x{i}"
-               for i, p in enumerate(parts)]
-        return "/".join(out)
+    from tools.workloads import concretize
 
     hot = [concretize(f) for f in filters[:n_hot]]
     cold_pool = [concretize(f) for f in filters[n_hot:n_hot + 4096]]
